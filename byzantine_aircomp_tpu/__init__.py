@@ -1,0 +1,19 @@
+"""byzantine_aircomp_tpu — TPU-native Byzantine-resilient over-the-air
+federated learning.
+
+A ground-up JAX/XLA/Pallas re-design of the capability set of
+goldenBill/Byzantine_AirComp (arXiv:2105.10883): K federated clients taking
+local SGD steps, a simulated Rayleigh-fading AirComp wireless channel, and
+robust server aggregation (geometric median, trimmed mean, median, Krum) —
+with the K-client loop vmapped and sharded over a TPU device mesh instead of
+time-multiplexed in Python.
+"""
+
+__version__ = "0.1.0"
+
+from .registry import AGGREGATORS, ATTACKS, DATASETS, MODELS, OPTIMIZERS  # noqa: F401
+
+# Importing the ops package registers the built-in aggregators/attacks as a
+# side effect — without this, `import byzantine_aircomp_tpu` would expose
+# empty registries.
+from . import ops  # noqa: E402,F401

@@ -1,0 +1,132 @@
+"""NumPy oracle implementations of the server-side math.
+
+This is the framework's ``--backend=ref`` path and the unit-test oracle: a
+direct, loop-style NumPy transcription of the semantics documented in
+SURVEY.md (aggregators ``/root/reference/MNIST_Air_weight.py:131-204``,
+channel ``:385-414``, weightflip ``:380-383``).  Deliberately *not*
+TPU-idiomatic — its job is to be obviously correct so the JAX/Pallas paths
+can be tested against it.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+DIST_CLAMP = 1e-4
+
+
+def mean(w: np.ndarray) -> np.ndarray:
+    return w.mean(axis=0)
+
+
+def median(w: np.ndarray) -> np.ndarray:
+    # torch.median(dim=0) semantics: lower middle order statistic for even K
+    k = w.shape[0]
+    return np.sort(w, axis=0)[(k - 1) // 2]
+
+
+def trimmed_mean(w: np.ndarray, trim_ratio: float = 0.1) -> np.ndarray:
+    k = w.shape[0]
+    beta = int(k * trim_ratio)
+    srt = np.sort(w, axis=0)
+    return srt[beta : k - beta].mean(axis=0)
+
+
+def _krum_scores(w: np.ndarray, honest_size: int) -> np.ndarray:
+    dist = ((w[:, None, :] - w[None, :, :]) ** 2).sum(axis=-1)
+    k_sel = honest_size - 2 + 1
+    return np.sort(dist, axis=1)[:, :k_sel].sum(axis=1)
+
+
+def krum(w: np.ndarray, honest_size: int) -> np.ndarray:
+    return w[int(np.argmin(_krum_scores(w, honest_size)))]
+
+
+def multi_krum(w: np.ndarray, honest_size: int, m: Optional[int] = None) -> np.ndarray:
+    m_sel = honest_size if m is None else m
+    idx = np.argsort(_krum_scores(w, honest_size))[:m_sel]
+    return w[idx].mean(axis=0)
+
+
+def gm2(
+    w: np.ndarray,
+    guess: Optional[np.ndarray] = None,
+    maxiter: int = 1000,
+    tol: float = 1e-5,
+) -> np.ndarray:
+    guess = w.mean(axis=0) if guess is None else guess.copy()
+    for _ in range(maxiter):
+        dist = np.maximum(DIST_CLAMP, np.linalg.norm(w - guess, axis=1))
+        nxt = (w / dist[:, None]).sum(axis=0) / (1.0 / dist).sum()
+        movement = np.linalg.norm(guess - nxt)
+        guess = nxt
+        if movement <= tol:
+            break
+    return guess
+
+
+def oma(
+    rng: np.random.Generator, message: np.ndarray, noise_var: float
+) -> np.ndarray:
+    k, d = message.shape
+    std = 1.0 / math.sqrt(2.0)
+    h_r = rng.normal(0.0, std, (k, 1))
+    h_i = rng.normal(0.0, std, (k, 1))
+    n_r = rng.normal(0.0, math.sqrt(noise_var), (k, d))
+    n_i = rng.normal(0.0, math.sqrt(noise_var), (k, d))
+    return message + (h_r * n_r + h_i * n_i) / (h_r**2 + h_i**2)
+
+
+def oma2(
+    rng: np.random.Generator,
+    message: np.ndarray,
+    p_max: float = 10.0,
+    noise_var: Optional[float] = None,
+    threshold: float = 1.0,
+) -> np.ndarray:
+    k, d = message.shape
+    std = 1.0 / math.sqrt(2.0)
+    h_r = rng.normal(0.0, std, (k,))
+    h_i = rng.normal(0.0, std, (k,))
+    h_sq = h_r**2 + h_i**2
+    p_upper = np.maximum((message**2).mean(axis=-1) / h_sq, threshold)
+    gain = np.sqrt(p_max / p_upper)
+    out = (message * gain[:, None]).sum(axis=0)
+    if noise_var is not None:
+        out = out + rng.normal(0.0, math.sqrt(noise_var / 2.0), (d,))
+    return out
+
+
+def gm(
+    rng: np.random.Generator,
+    w: np.ndarray,
+    noise_var: Optional[float] = None,
+    guess: Optional[np.ndarray] = None,
+    maxiter: int = 1000,
+    tol: float = 1e-5,
+    p_max: float = 1.0,
+) -> np.ndarray:
+    guess = w.mean(axis=0) if guess is None else guess.copy()
+    for _ in range(maxiter):
+        scaler = math.sqrt(float((guess**2).mean()))
+        dist = np.maximum(DIST_CLAMP, np.linalg.norm(w - guess, axis=1))
+        msg = np.concatenate([w / dist[:, None], scaler / dist[:, None]], axis=1)
+        noisy = oma2(
+            rng, msg, p_max=p_max, noise_var=noise_var, threshold=500.0 * scaler**2
+        )
+        nxt = noisy[:-1] / noisy[-1] * scaler
+        movement = np.linalg.norm(guess - nxt)
+        guess = nxt
+        if movement <= tol:
+            break
+    return guess
+
+
+def weightflip(w: np.ndarray, byz_size: int) -> np.ndarray:
+    out = w.copy()
+    s = w[:-byz_size].sum(axis=0)
+    out[-byz_size:] = -w[-byz_size:] - 2.0 * s / byz_size
+    return out

@@ -1,0 +1,1 @@
+from . import aggregators, attacks, channel, flatten  # noqa: F401

@@ -1,0 +1,90 @@
+"""Param-pytree <-> flat-vector plumbing.
+
+TPU-native replacement for the reference's list-based flatten/unflatten
+(``/root/reference/MNIST_Air_weight.py:206-218``): instead of per-parameter
+Python loops we precompute a static :class:`FlatSpec` once per model and use
+fused ``concatenate``/``dynamic_slice`` ops, so flatten/unflatten trace into a
+handful of XLA reshapes that fuse away entirely under ``jit``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class FlatSpec:
+    """Static description of a params pytree's flattened layout."""
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]
+    offsets: Tuple[int, ...]
+    dtypes: Tuple[Any, ...]
+    total: int
+
+
+def make_flat_spec(params) -> FlatSpec:
+    leaves, treedef = jax.tree.flatten(params)
+    shapes = tuple(tuple(l.shape) for l in leaves)
+    sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+    offsets = tuple(int(o) for o in np.cumsum((0,) + sizes[:-1]))
+    dtypes = tuple(l.dtype for l in leaves)
+    return FlatSpec(treedef, shapes, sizes, offsets, dtypes, int(sum(sizes)))
+
+
+def _check_spec(leaves, treedef, spec: FlatSpec):
+    if treedef != spec.treedef or tuple(tuple(l.shape) for l in leaves) != spec.shapes:
+        raise ValueError(
+            "params pytree does not match FlatSpec: "
+            f"got treedef {treedef} with shapes {[tuple(l.shape) for l in leaves]}, "
+            f"spec has {spec.treedef} with shapes {list(spec.shapes)}"
+        )
+
+
+def flatten(params, spec: FlatSpec) -> jnp.ndarray:
+    """Pytree -> [d] float32 vector (reference ``flatten_list`` row)."""
+    leaves, treedef = jax.tree.flatten(params)
+    _check_spec(leaves, treedef, spec)
+    return jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves])
+
+
+def unflatten(vector: jnp.ndarray, spec: FlatSpec):
+    """[d] vector -> pytree (reference ``unflatten_vector``)."""
+    leaves = [
+        jax.lax.dynamic_slice_in_dim(vector, off, size).reshape(shape).astype(dt)
+        for off, size, shape, dt in zip(spec.offsets, spec.sizes, spec.shapes, spec.dtypes)
+    ]
+    return jax.tree.unflatten(spec.treedef, leaves)
+
+
+def flatten_stack(params_stacked, spec: FlatSpec) -> jnp.ndarray:
+    """Client-stacked pytree (leading K axis on every leaf) -> [K, d] matrix.
+
+    Replaces the reference's ``flatten_list`` over a Python list of per-client
+    parameter lists (``MNIST_Air_weight.py:206-209``); here the K axis is a
+    real array axis so the result is produced by K-preserving reshapes only.
+    """
+    leaves, treedef = jax.tree.flatten(params_stacked)
+    k = leaves[0].shape[0]
+    _check_spec([l[0] for l in leaves], treedef, spec)
+    return jnp.concatenate(
+        [l.reshape(k, -1).astype(jnp.float32) for l in leaves], axis=1
+    )
+
+
+def unflatten_stack(matrix: jnp.ndarray, spec: FlatSpec):
+    """[K, d] -> pytree with leading K axis on every leaf."""
+    k = matrix.shape[0]
+    leaves = [
+        jax.lax.dynamic_slice_in_dim(matrix, off, size, axis=1)
+        .reshape((k,) + shape)
+        .astype(dt)
+        for off, size, shape, dt in zip(spec.offsets, spec.sizes, spec.shapes, spec.dtypes)
+    ]
+    return jax.tree.unflatten(spec.treedef, leaves)

@@ -1,0 +1,57 @@
+"""Name -> callable registries.
+
+The reference dispatches CLI strings to functions with ``eval`` (see
+``/root/reference/MNIST_Air_weight.py:433`` and ``:580``).  We keep the same
+public names (``gm``, ``gm2``, ``mean``, ``trimmed_mean``, ``median``, ``Krum``,
+``classflip``, ``dataflip``, ``weightflip`` ...) but resolve them through
+explicit registries so the CLI surface is identical without executing
+arbitrary strings.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Optional
+
+
+class Registry:
+    """A simple name -> object registry with decorator support."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._entries: Dict[str, Callable] = {}
+
+    def register(self, name: Optional[str] = None, *, aliases: Iterable[str] = ()):
+        def wrap(fn: Callable) -> Callable:
+            key = name or fn.__name__
+            if key in self._entries:
+                raise KeyError(f"duplicate {self.kind} registration: {key!r}")
+            self._entries[key] = fn
+            for alias in aliases:
+                if alias in self._entries:
+                    raise KeyError(f"duplicate {self.kind} alias: {alias!r}")
+                self._entries[alias] = fn
+            return fn
+
+        return wrap
+
+    def get(self, name: str) -> Callable:
+        try:
+            return self._entries[name]
+        except KeyError:
+            known = ", ".join(sorted(self._entries))
+            raise KeyError(
+                f"unknown {self.kind} {name!r}; known: {known}"
+            ) from None
+
+    def names(self):
+        return sorted(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._entries
+
+
+AGGREGATORS = Registry("aggregator")
+ATTACKS = Registry("attack")
+MODELS = Registry("model")
+DATASETS = Registry("dataset")
+OPTIMIZERS = Registry("optimizer")

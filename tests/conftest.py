@@ -1,0 +1,25 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors SURVEY.md §4's "distributed without a cluster" strategy — the TPU
+analog of the reference's in-process simulation.  This container's
+sitecustomize pre-imports jax with ``JAX_PLATFORMS=axon``, so the platform
+must be overridden via ``jax.config`` (env vars alone are too late), and the
+XLA host-device-count flag must land before the CPU backend initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+assert jax.default_backend() == "cpu"
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for mesh tests"

@@ -1,0 +1,159 @@
+"""Aggregators vs the NumPy oracle (SURVEY.md §4 unit strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu.backends import numpy_ref
+from byzantine_aircomp_tpu.ops import aggregators as agg
+
+K, D = 12, 37
+
+
+@pytest.fixture
+def wmat():
+    rng = np.random.default_rng(0)
+    return rng.normal(size=(K, D)).astype(np.float32)
+
+
+def test_mean(wmat):
+    got = agg.mean(jnp.asarray(wmat))
+    np.testing.assert_allclose(got, numpy_ref.mean(wmat), rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("k", [11, 12, 50])
+def test_median_torch_semantics(k):
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(k, D)).astype(np.float32)
+    got = np.asarray(agg.median(jnp.asarray(w)))
+    want = numpy_ref.median(w)
+    np.testing.assert_array_equal(got, want)
+    # for even k this is the LOWER middle, not the midpoint average
+    if k % 2 == 0:
+        assert not np.allclose(want, np.median(w, axis=0))
+
+
+@pytest.mark.parametrize("k", [10, 20, 50])
+def test_trimmed_mean(k):
+    rng = np.random.default_rng(2)
+    w = rng.normal(size=(k, D)).astype(np.float32)
+    got = np.asarray(agg.trimmed_mean(jnp.asarray(w)))
+    np.testing.assert_allclose(got, numpy_ref.trimmed_mean(w), rtol=1e-4, atol=1e-7)
+
+
+def test_trimmed_mean_drops_extremes():
+    # one row of huge outliers must not affect the result when beta >= 1
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(10, D)).astype(np.float32)
+    w_out = w.copy()
+    w_out[0] = 1e6
+    a = np.asarray(agg.trimmed_mean(jnp.asarray(w)))
+    b = np.asarray(agg.trimmed_mean(jnp.asarray(w_out)))
+    # replacing a row changes which rows are trimmed, but the huge value
+    # itself must be excluded
+    assert np.abs(b).max() < 1e3
+    assert np.abs(a - b).max() < 10
+
+
+def test_krum_selects_cluster_member():
+    # crafted constellation: tight honest cluster + far outliers
+    rng = np.random.default_rng(4)
+    honest = rng.normal(size=(8, D)).astype(np.float32) * 0.01
+    byz = rng.normal(size=(4, D)).astype(np.float32) + 50.0
+    w = np.concatenate([honest, byz]).astype(np.float32)
+    got = np.asarray(agg.krum(jnp.asarray(w), honest_size=8))
+    want = numpy_ref.krum(w, honest_size=8)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # selected vector is one of the honest rows
+    assert min(np.linalg.norm(honest - got, axis=1)) < 1e-6
+
+
+def test_krum_matches_oracle_random(wmat):
+    got = np.asarray(agg.krum(jnp.asarray(wmat), honest_size=9))
+    want = numpy_ref.krum(wmat, honest_size=9)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_krum(wmat):
+    got = np.asarray(agg.multi_krum(jnp.asarray(wmat), honest_size=9, m=5))
+    want = numpy_ref.multi_krum(wmat, honest_size=9, m=5)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_gm2_matches_oracle(wmat):
+    guess = wmat.mean(axis=0)
+    got = np.asarray(
+        agg.gm2(jnp.asarray(wmat), guess=jnp.asarray(guess), maxiter=1000, tol=1e-7)
+    )
+    want = numpy_ref.gm2(wmat, guess=guess, maxiter=1000, tol=1e-7)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_gm2_fixed_point_property(wmat):
+    # the geometric median minimizes sum of distances: perturbations increase it
+    gm_pt = np.asarray(agg.gm2(jnp.asarray(wmat), tol=1e-8))
+
+    def obj(p):
+        return np.linalg.norm(wmat - p, axis=1).sum()
+
+    base = obj(gm_pt)
+    rng = np.random.default_rng(5)
+    for _ in range(5):
+        assert obj(gm_pt + 1e-2 * rng.normal(size=D)) >= base - 1e-4
+
+
+def test_gm2_robust_to_outliers():
+    rng = np.random.default_rng(6)
+    honest = rng.normal(size=(9, D)).astype(np.float32)
+    byz = np.full((3, D), 1e4, np.float32)
+    w = np.concatenate([honest, byz]).astype(np.float32)
+    gm_pt = np.asarray(agg.gm2(jnp.asarray(w), tol=1e-6))
+    assert np.linalg.norm(gm_pt - honest.mean(axis=0)) < 5.0
+
+
+def test_gm2_early_exit_iteration_count(wmat):
+    # tol so loose a single step converges -> result equals one Weiszfeld step
+    guess = wmat.mean(axis=0)
+    one = np.asarray(
+        agg.gm2(jnp.asarray(wmat), guess=jnp.asarray(guess), maxiter=1, tol=1e-7)
+    )
+    loose = np.asarray(
+        agg.gm2(jnp.asarray(wmat), guess=jnp.asarray(guess), maxiter=1000, tol=1e9)
+    )
+    np.testing.assert_allclose(one, loose, rtol=1e-6)
+
+
+def test_gm_ideal_channel_close_to_gm2(wmat):
+    # without receiver noise the only distortion is power control; with unit
+    # P_max and a generous threshold the air sum preserves the ratio
+    key = jax.random.PRNGKey(0)
+    got = np.asarray(
+        agg.gm(jnp.asarray(wmat), key=key, noise_var=None, maxiter=200, tol=1e-6)
+    )
+    ideal = np.asarray(agg.gm2(jnp.asarray(wmat), maxiter=200, tol=1e-6))
+    # both estimate the same geometric median; AirComp power control preserves
+    # the num/denom ratio exactly when all clients share one gain... they
+    # don't, so allow a loose tolerance
+    assert np.linalg.norm(got - ideal) / (np.linalg.norm(ideal) + 1e-9) < 0.5
+
+
+def test_gm_jits_and_is_finite(wmat):
+    key = jax.random.PRNGKey(1)
+    fn = jax.jit(
+        lambda w, k: agg.gm(w, key=k, noise_var=1e-2, maxiter=50, tol=1e-5)
+    )
+    out = np.asarray(fn(jnp.asarray(wmat), key))
+    assert out.shape == (D,)
+    assert np.isfinite(out).all()
+
+
+def test_channel_dispatch_rule():
+    assert not agg.needs_oma_prepass("gm")
+    for name in ["gm2", "mean", "median", "trimmed_mean", "krum"]:
+        assert agg.needs_oma_prepass(name)
+
+
+def test_registry_names():
+    for name in ["gm", "gm2", "mean", "median", "trimmed_mean", "Krum", "krum", "multi_krum"]:
+        assert agg.resolve(name) is not None

@@ -1,0 +1,79 @@
+"""Statistical tests of the channel models (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from byzantine_aircomp_tpu.ops import channel
+
+
+def test_oma_zero_mean_corruption():
+    # E[(h_r n_r + h_i n_i)/|h|^2] = 0; variance of the residual is
+    # noise_var * E[1/|h|^2-ish] — check mean over many draws
+    k, d = 64, 128
+    msg = jnp.zeros((k, d), jnp.float32)
+    keys = jax.random.split(jax.random.PRNGKey(0), 32)
+    outs = np.stack([np.asarray(channel.oma(kk, msg, 1e-2)) for kk in keys])
+    assert abs(outs.mean()) < 5e-3
+
+
+def test_oma_additive_only():
+    # corruption is independent of the message content: same key, different
+    # message -> identical residual
+    k, d = 8, 16
+    key = jax.random.PRNGKey(1)
+    a = jnp.zeros((k, d))
+    b = jnp.ones((k, d))
+    res_a = np.asarray(channel.oma(key, a, 1e-2))
+    res_b = np.asarray(channel.oma(key, b, 1e-2)) - 1.0
+    np.testing.assert_allclose(res_a, res_b, rtol=1e-5, atol=1e-6)
+
+
+def test_oma2_noiseless_is_weighted_sum():
+    k, d = 8, 16
+    key = jax.random.PRNGKey(2)
+    msg = jax.random.normal(jax.random.PRNGKey(3), (k, d))
+    out = np.asarray(channel.oma2(key, msg, p_max=1.0, noise_var=None, threshold=1e-9))
+    # with a tiny threshold, power control is pure channel inversion:
+    # gain_i = sqrt(P_max / mean(m_i^2) * h_i^2)... just check it's a
+    # deterministic weighted sum: rows scale linearly
+    out2 = np.asarray(
+        channel.oma2(key, 2.0 * msg, p_max=1.0, noise_var=None, threshold=1e-9)
+    )
+    # doubling the message doubles m_i but gain_i shrinks by 2 (channel
+    # inversion regime): sum is invariant
+    np.testing.assert_allclose(out, out2, rtol=1e-4, atol=1e-5)
+
+
+def test_oma2_threshold_clips_power():
+    # with a huge threshold every client's P_upper == threshold, so
+    # gain is the constant sqrt(P_max/threshold) and the output is an exact
+    # scaled sum (truncated power control, reference :404-407)
+    k, d = 8, 16
+    key = jax.random.PRNGKey(4)
+    msg = jax.random.normal(jax.random.PRNGKey(5), (k, d))
+    thr = 1e9
+    out = np.asarray(channel.oma2(key, msg, p_max=4.0, noise_var=None, threshold=thr))
+    want = np.asarray(msg).sum(axis=0) * np.sqrt(4.0 / thr)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-8)
+
+
+def test_oma2_receiver_noise_variance():
+    # noise_var set: elementwise AWGN with variance noise_var/2 on the sum
+    k, d = 4, 4096
+    msg = jnp.zeros((k, d), jnp.float32)
+    keys = jax.random.split(jax.random.PRNGKey(6), 16)
+    noise_var = 0.04
+    outs = np.concatenate(
+        [np.asarray(channel.oma2(kk, msg, noise_var=noise_var)) for kk in keys]
+    )
+    assert abs(outs.mean()) < 5e-3
+    np.testing.assert_allclose(outs.var(), noise_var / 2.0, rtol=0.1)
+
+
+def test_rayleigh_fade_moments():
+    keys = jax.random.split(jax.random.PRNGKey(7), 64)
+    h = np.stack([np.stack(channel.rayleigh_fade(k, 256)) for k in keys])
+    # each component ~ N(0, 1/2)
+    np.testing.assert_allclose(h.var(), 0.5, rtol=0.05)
+    assert abs(h.mean()) < 0.01

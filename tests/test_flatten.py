@@ -1,0 +1,76 @@
+"""Flatten/unflatten round-trip tests (reference :206-218 semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from byzantine_aircomp_tpu.ops import flatten as fl
+
+
+def _params():
+    return {
+        "linear": {
+            "w": jnp.arange(12.0, dtype=jnp.float32).reshape(3, 4),
+            "b": jnp.array([1.0, 2.0, 3.0], jnp.float32),
+        },
+        "head": {"w": jnp.ones((2, 3), jnp.float32)},
+    }
+
+
+def test_round_trip():
+    p = _params()
+    spec = fl.make_flat_spec(p)
+    v = fl.flatten(p, spec)
+    assert v.shape == (spec.total,) == (12 + 3 + 6,)
+    p2 = fl.unflatten(v, spec)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b), p, p2)
+
+
+def test_stack_round_trip():
+    p = _params()
+    spec = fl.make_flat_spec(p)
+    k = 5
+    stacked = jax.tree.map(lambda l: jnp.stack([l + i for i in range(k)]), p)
+    m = fl.flatten_stack(stacked, spec)
+    assert m.shape == (k, spec.total)
+    back = fl.unflatten_stack(m, spec)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b), stacked, back)
+
+
+def test_stack_row_equals_single_flatten():
+    p = _params()
+    spec = fl.make_flat_spec(p)
+    stacked = jax.tree.map(lambda l: jnp.stack([l, 2 * l]), p)
+    m = fl.flatten_stack(stacked, spec)
+    row0 = fl.flatten(jax.tree.map(lambda l: l[0], stacked), spec)
+    np.testing.assert_array_equal(np.asarray(m[0]), np.asarray(row0))
+
+
+def test_spec_mismatch_raises():
+    import pytest
+
+    p = _params()
+    spec = fl.make_flat_spec(p)
+    wrong_shape = dict(p, head={"w": jnp.ones((3, 3), jnp.float32)})
+    with pytest.raises(ValueError, match="does not match FlatSpec"):
+        fl.flatten(wrong_shape, spec)
+    wrong_tree = {"only": jnp.ones(3)}
+    with pytest.raises(ValueError, match="does not match FlatSpec"):
+        fl.flatten(wrong_tree, spec)
+
+
+def test_flatten_under_jit_and_vmap():
+    p = _params()
+    spec = fl.make_flat_spec(p)
+
+    @jax.jit
+    def go(p):
+        return fl.flatten(p, spec)
+
+    np.testing.assert_array_equal(np.asarray(go(p)), np.asarray(fl.flatten(p, spec)))
+
+    stacked = jax.tree.map(lambda l: jnp.stack([l, l * 3]), p)
+    vm = jax.vmap(lambda q: fl.flatten(q, spec))(stacked)
+    np.testing.assert_array_equal(
+        np.asarray(vm), np.asarray(fl.flatten_stack(stacked, spec))
+    )

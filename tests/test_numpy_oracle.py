@@ -1,0 +1,54 @@
+"""Self-tests for the NumPy oracle's channel/gm path.
+
+The oracle is the authority the JAX ops are tested against, so its own
+statistical/algebraic properties need independent coverage.
+"""
+
+import numpy as np
+
+from byzantine_aircomp_tpu.backends import numpy_ref
+
+
+def test_oma_zero_mean():
+    rng = np.random.default_rng(0)
+    msg = np.zeros((64, 256))
+    outs = np.stack([numpy_ref.oma(rng, msg, 1e-2) for _ in range(16)])
+    assert abs(outs.mean()) < 5e-3
+
+
+def test_oma2_threshold_clips_power():
+    # huge threshold -> constant gain sqrt(P_max/threshold), exact scaled sum
+    rng = np.random.default_rng(1)
+    msg = rng.normal(size=(8, 16))
+    thr = 1e9
+    out = numpy_ref.oma2(rng, msg, p_max=4.0, noise_var=None, threshold=thr)
+    np.testing.assert_allclose(out, msg.sum(axis=0) * np.sqrt(4.0 / thr), rtol=1e-10)
+
+
+def test_oma2_receiver_noise_variance():
+    # the /2 in the receiver-noise std: variance must be noise_var/2
+    rng = np.random.default_rng(2)
+    msg = np.zeros((4, 200000))
+    noise_var = 0.04
+    out = numpy_ref.oma2(rng, msg, noise_var=noise_var)
+    np.testing.assert_allclose(out.var(), noise_var / 2.0, rtol=0.05)
+
+
+def test_gm_noiseless_matches_gm2_in_tight_cluster():
+    # realistic FL regime: clients one local step apart; ideal receiver.
+    # AirComp gm and ideal gm2 should land near the same point.
+    rng = np.random.default_rng(3)
+    g = rng.normal(size=50) * 0.05
+    w = (g[None, :] + 1e-3 * rng.normal(size=(20, 50))).astype(np.float64)
+    out_gm = numpy_ref.gm(np.random.default_rng(4), w, noise_var=None, guess=g.copy())
+    out_gm2 = numpy_ref.gm2(w, guess=g.copy())
+    assert np.linalg.norm(out_gm - out_gm2) < 1e-3
+
+
+def test_gm_converges_with_noise():
+    rng = np.random.default_rng(5)
+    g = rng.normal(size=50) * 0.05
+    w = (g[None, :] + 1e-3 * rng.normal(size=(20, 50))).astype(np.float64)
+    out = numpy_ref.gm(np.random.default_rng(6), w, noise_var=1e-2, guess=g.copy())
+    assert np.isfinite(out).all()
+    assert np.linalg.norm(out - w.mean(axis=0)) < 0.1
