@@ -13,7 +13,7 @@ __version__ = "0.1.0"
 
 from .registry import AGGREGATORS, ATTACKS, DATASETS, MODELS, OPTIMIZERS  # noqa: F401
 
-# Importing the ops package registers the built-in aggregators/attacks as a
-# side effect — without this, `import byzantine_aircomp_tpu` would expose
-# empty registries.
-from . import ops  # noqa: E402,F401
+# Importing these packages registers the built-in aggregators/attacks/models/
+# datasets as a side effect — without this, `import byzantine_aircomp_tpu`
+# would expose empty registries.
+from . import data, fed, models, ops  # noqa: E402,F401

@@ -1,0 +1,172 @@
+"""``--backend=ref``: a NumPy oracle training loop (MLP only).
+
+The north star keeps a non-JAX reference path behind the same CLI so the TPU
+backend can be validated end-to-end ("matching CPU-reference test accuracy
+within 0.5%").  This is a loop-style NumPy transcription of the reference's
+``SGD`` round loop (``/root/reference/MNIST_Air_weight.py:226-372``) for the
+linear MLP model: per-client manual softmax-regression gradients, the same
+attack/channel/aggregation order, the same contiguous sharding and
+with-replacement sampling.  Deliberately simple and slow — it exists to be
+obviously correct.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from ..data import datasets as data_lib
+from ..fed.config import FedConfig
+from . import numpy_ref
+
+
+def _softmax(z):
+    z = z - z.max(axis=1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def _ce_loss(logits, y):
+    p = _softmax(logits)
+    return -np.log(np.maximum(p[np.arange(len(y)), y], 1e-12))
+
+
+def _init_mlp(rng: np.random.Generator, d_in: int, n_cls: int):
+    # xavier-normal with relu gain, bias 0.01 (reference :92-95)
+    std = np.sqrt(2.0) * np.sqrt(2.0 / (d_in + n_cls))
+    w = rng.normal(0.0, std, (d_in, n_cls)).astype(np.float32)
+    b = np.full((n_cls,), 0.01, np.float32)
+    return np.concatenate([w.reshape(-1), b])
+
+
+def _grad(flat, x, y, d_in, n_cls):
+    w = flat[: d_in * n_cls].reshape(d_in, n_cls)
+    b = flat[d_in * n_cls :]
+    logits = x @ w + b
+    delta = _softmax(logits)
+    delta[np.arange(len(y)), y] -= 1.0
+    delta /= len(y)
+    gw = x.T @ delta
+    gb = delta.sum(axis=0)
+    return np.concatenate([gw.reshape(-1), gb])
+
+
+def _eval(flat, x, y, d_in, n_cls):
+    w = flat[: d_in * n_cls].reshape(d_in, n_cls)
+    b = flat[d_in * n_cls :]
+    logits = x @ w + b
+    loss = float(_ce_loss(logits, y).mean())
+    acc = float((logits.argmax(axis=1) == y).mean())
+    return loss, acc
+
+
+def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
+    assert cfg.model == "MLP", "ref backend implements the MLP path only"
+    if cfg.attack is None:
+        cfg.byz_size = 0
+    cfg.validate()
+    _KNOWN_ATTACKS = {"classflip", "dataflip", "gradascent", "weightflip", "signflip"}
+    if cfg.attack is not None and cfg.attack not in _KNOWN_ATTACKS:
+        raise KeyError(
+            f"ref backend: unknown attack {cfg.attack!r}; known: "
+            f"{sorted(_KNOWN_ATTACKS)}"
+        )
+
+    ds = dataset if dataset is not None else data_lib.load(cfg.dataset)
+    n_cls = ds.num_classes
+    x_tr = ds.x_train.reshape(len(ds.x_train), -1)
+    y_tr = ds.y_train
+    x_va = ds.x_val.reshape(len(ds.x_val), -1)
+    y_va = ds.y_val
+    d_in = x_tr.shape[1]
+
+    k = cfg.node_size
+    shards = data_lib.contiguous_shards(len(x_tr), k)
+
+    rng = np.random.default_rng(cfg.seed)
+    flat = _init_mlp(rng, d_in, n_cls)
+
+    tr = _eval(flat, x_tr, y_tr, d_in, n_cls) if cfg.eval_train else (0.0, 0.0)
+    va = _eval(flat, x_va, y_va, d_in, n_cls)
+    paths: Dict[str, List[float]] = {
+        "trainLossPath": [tr[0]],
+        "trainAccPath": [tr[1]],
+        "valLossPath": [va[0]],
+        "valAccPath": [va[1]],
+        "variencePath": [],
+        "roundsPerSec": [],
+    }
+    log_fn(f"[ref backend] round 0: val loss={va[0]:.4f} acc={va[1]:.4f}")
+
+    byz0 = cfg.honest_size  # Byzantine clients are the last byz_size rows
+    for r in range(cfg.rounds):
+        t0 = time.perf_counter()
+        for _ in range(cfg.display_interval):
+            w_stack = np.empty((k, flat.size), np.float32)
+            for node in range(k):
+                lo = shards.offsets[node]
+                idx = lo + rng.integers(0, shards.sizes[node], cfg.batch_size)
+                xb, yb = x_tr[idx], y_tr[idx]
+                if node >= byz0 and cfg.attack == "classflip":
+                    yb = (n_cls - 1) - yb
+                elif node >= byz0 and cfg.attack == "dataflip":
+                    xb = 1.0 - xb
+                g = _grad(flat, xb, yb, d_in, n_cls)
+                if node >= byz0 and cfg.attack == "gradascent":
+                    g = -g
+                w_stack[node] = flat - cfg.gamma * (g + cfg.weight_decay * flat)
+
+            if cfg.attack == "weightflip" and cfg.byz_size:
+                w_stack = numpy_ref.weightflip(w_stack, cfg.byz_size)
+            elif cfg.attack == "signflip" and cfg.byz_size:
+                w_stack[-cfg.byz_size :] *= -1.0
+
+            if cfg.noise_var is not None and cfg.agg != "gm":
+                w_stack = numpy_ref.oma(rng, w_stack, cfg.noise_var)
+
+            if cfg.agg == "gm":
+                flat = numpy_ref.gm(
+                    rng,
+                    w_stack,
+                    noise_var=cfg.noise_var,
+                    guess=flat,
+                    maxiter=cfg.agg_maxiter,
+                    tol=cfg.agg_tol,
+                    p_max=cfg.gm_p_max,
+                ).astype(np.float32)
+            elif cfg.agg == "gm2":
+                flat = numpy_ref.gm2(
+                    w_stack, guess=flat, maxiter=cfg.agg_maxiter, tol=cfg.agg_tol
+                ).astype(np.float32)
+            elif cfg.agg == "mean":
+                flat = numpy_ref.mean(w_stack)
+            elif cfg.agg == "median":
+                flat = numpy_ref.median(w_stack)
+            elif cfg.agg == "trimmed_mean":
+                flat = numpy_ref.trimmed_mean(w_stack)
+            elif cfg.agg in ("krum", "Krum"):
+                flat = numpy_ref.krum(w_stack, cfg.honest_size).copy()
+            elif cfg.agg == "multi_krum":
+                flat = numpy_ref.multi_krum(w_stack, cfg.honest_size)
+            else:
+                raise KeyError(f"ref backend: unknown aggregator {cfg.agg!r}")
+
+        w_h = w_stack[: cfg.honest_size]
+        variance = float(((w_h - w_h.mean(axis=0)) ** 2).sum(axis=1).mean())
+        dt = time.perf_counter() - t0
+
+        tr = _eval(flat, x_tr, y_tr, d_in, n_cls) if cfg.eval_train else (0.0, 0.0)
+        va = _eval(flat, x_va, y_va, d_in, n_cls)
+        paths["trainLossPath"].append(tr[0])
+        paths["trainAccPath"].append(tr[1])
+        paths["valLossPath"].append(va[0])
+        paths["valAccPath"].append(va[1])
+        paths["variencePath"].append(variance)
+        paths["roundsPerSec"].append(1.0 / dt)
+        log_fn(
+            f"[ref backend] round {r + 1}/{cfg.rounds}: "
+            f"train acc={tr[1]:.4f} val acc={va[1]:.4f}"
+        )
+    return paths

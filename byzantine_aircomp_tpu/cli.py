@@ -1,0 +1,96 @@
+"""CLI mirroring the reference's argparse surface plus ``--backend``.
+
+Reference flags (``/root/reference/MNIST_Air_weight.py:16-28``): ``--opt``,
+``--agg``, ``--attack``, ``--var``, ``--inherit``, ``--mark``, ``--use-gpu``,
+``--K``, ``--B``.  All are accepted here with the same names and defaults;
+``--use-gpu`` is accepted-and-ignored (device selection is JAX's), and
+``--inherit`` now actually works (resume from checkpoint) instead of being the
+reference's dead flag (``:22,:500``).  New flags: ``--backend {jax,ref}``
+(north-star gate; ``ref`` = NumPy oracle path), ``--dataset``, ``--model``,
+``--rounds``, ``--interval``, ``--batch-size``, ``--gamma``, ``--seed``.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+from .fed.config import FedConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("byzantine_aircomp_tpu")
+    # reference surface
+    p.add_argument("--opt", type=str, default="SGD", help="optimizer")
+    p.add_argument("--agg", type=str, default="gm", help="aggregator name")
+    p.add_argument("--attack", type=str, default=None, help="attack name")
+    p.add_argument("--var", type=float, default=None, help="channel noise variance")
+    p.add_argument("--inherit", action="store_true", help="resume from checkpoint")
+    p.add_argument("--mark", type=str, default="", help="mark on title")
+    p.add_argument(
+        "--use-gpu",
+        type=str,
+        default="true",
+        help="accepted for reference-CLI compatibility; device choice is JAX's",
+    )
+    p.add_argument("--K", type=int, default=None, help="number of total devices")
+    p.add_argument("--B", type=int, default=None, help="number of Byzantine devices")
+    # framework surface
+    p.add_argument("--backend", choices=["jax", "ref"], default="jax")
+    p.add_argument("--dataset", type=str, default="mnist")
+    p.add_argument("--model", type=str, default="MLP")
+    p.add_argument("--rounds", type=int, default=100)
+    p.add_argument("--interval", type=int, default=10, help="displayInterval")
+    p.add_argument("--batch-size", type=int, default=50)
+    p.add_argument("--gamma", type=float, default=1e-2)
+    p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=2021)
+    p.add_argument("--cache-dir", type=str, default="")
+    p.add_argument("--no-eval-train", action="store_true")
+    p.add_argument("--checkpoint-dir", type=str, default="")
+    return p
+
+
+def config_from_args(args) -> FedConfig:
+    cfg = FedConfig(
+        opt=args.opt,
+        agg=args.agg,
+        attack=args.attack,
+        noise_var=args.var,
+        checkpoint_dir=args.checkpoint_dir,
+        inherit=args.inherit,
+        rounds=args.rounds,
+        display_interval=args.interval,
+        batch_size=args.batch_size,
+        gamma=args.gamma,
+        weight_decay=args.weight_decay,
+        seed=args.seed,
+        model=args.model,
+        dataset=args.dataset,
+        mark=args.mark,
+        cache_dir=args.cache_dir,
+        eval_train=not args.no_eval_train,
+    )
+    # reference --K/--B override: honestSize = K - B (:531-533)
+    if args.K is not None and args.B is not None:
+        cfg.honest_size = args.K - args.B
+        cfg.byz_size = args.B
+    elif args.K is not None:
+        cfg.honest_size = args.K
+    return cfg
+
+
+def main(argv: Optional[Sequence[str]] = None):
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    if args.backend == "ref":
+        from .backends.ref_trainer import run_ref
+
+        return run_ref(cfg)
+    from .fed.harness import run
+
+    return run(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+from .datasets import (  # noqa: F401
+    ClientSharding,
+    Dataset,
+    contiguous_shards,
+    load,
+    sample_client_batch_indices,
+)

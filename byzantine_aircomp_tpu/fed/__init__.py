@@ -1,0 +1,9 @@
+from ..registry import OPTIMIZERS
+from .config import FedConfig  # noqa: F401
+from .train import FedTrainer  # noqa: F401
+
+# The reference's --opt selects the federated optimizer function by name via
+# eval (MNIST_Air_weight.py:580); only SGD exists (:226).  Same surface here,
+# through the registry.
+if "SGD" not in OPTIMIZERS:
+    OPTIMIZERS.register("SGD")(FedTrainer)

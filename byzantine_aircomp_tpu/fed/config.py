@@ -1,0 +1,74 @@
+"""Run configuration.
+
+Field names and defaults mirror the reference's ``optConfig`` / CLI surface
+(``/root/reference/MNIST_Air_weight.py:16-28, :516-544``): K=50 honest clients,
+100 rounds x displayInterval 10, batch 50, gamma 1e-2, weight_decay 0,
+seed 2021, gm/gm2 maxiter 1000 tol 1e-5 (``:350``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class FedConfig:
+    # topology
+    honest_size: int = 50
+    byz_size: int = 0
+
+    # schedule (reference: rounds=100, displayInterval=10)
+    rounds: int = 100
+    display_interval: int = 10
+
+    # optimizer (reference SGD: w <- w - gamma*(grad + wd*w))
+    gamma: float = 1e-2
+    weight_decay: float = 0.0
+    batch_size: int = 50
+
+    # dispatch
+    agg: str = "gm"
+    attack: Optional[str] = None
+    noise_var: Optional[float] = None
+
+    # aggregator options (reference options dict, :350)
+    agg_maxiter: int = 1000
+    agg_tol: float = 1e-5
+    gm_p_max: float = 1.0
+
+    # determinism
+    seed: int = 2021
+    fix_seed: bool = True
+
+    # model / data
+    model: str = "MLP"
+    dataset: str = "mnist"
+    fc_width: int = 1024
+
+    # eval
+    eval_batch: int = 2000
+    eval_train: bool = True  # EMNIST reference skips train-set eval
+
+    # federated optimizer (registry name; reference --opt, only SGD exists)
+    opt: str = "SGD"
+
+    # checkpoint / resume (the reference's --inherit is dead; ours works)
+    checkpoint_dir: str = ""
+    inherit: bool = False
+
+    # misc
+    mark: str = ""
+    cache_dir: str = ""
+
+    @property
+    def node_size(self) -> int:
+        return self.honest_size + self.byz_size
+
+    def validate(self):
+        # reference asserts (MNIST_Air_weight.py:229-230)
+        assert self.byz_size == 0 or self.attack is not None, (
+            "byz_size > 0 requires an attack"
+        )
+        assert self.honest_size != 0, "honest_size must be nonzero"
+        return self

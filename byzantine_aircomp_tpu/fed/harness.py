@@ -1,0 +1,165 @@
+"""Experiment harness: titles, banner, pickled metric records.
+
+Reproduces the reference's ``run`` observability surface
+(``/root/reference/MNIST_Air_weight.py:427-492``) so existing analysis
+(draw.ipynb's pickle-loading cells) keeps working against this framework's
+output: same title scheme ``{Model}_{opt}_{attack|baseline}_{agg}[_{var}][_{mark}]``
+(``:446-455``), same cache-dir convention ``{name}_K{K}_B{B}_`` (``:546-550``),
+same record keys including the ``variencePath`` spelling (``:481-489``).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import time
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from ..data import datasets as data_lib
+from . import checkpoint
+from .config import FedConfig
+from .train import FedTrainer
+
+
+def log(*k, **kw):
+    """Timestamped stdout logging (reference ``log``, ``:40-44``)."""
+    stamp = time.strftime("[%m-%d %H:%M:%S] ", time.localtime())
+    print(stamp, end="")
+    print(*k, **kw)
+    sys.stdout.flush()
+
+
+def run_title(cfg: FedConfig) -> str:
+    attack_name = cfg.attack if cfg.attack is not None else "baseline"
+    title = f"{cfg.model}_{cfg.opt}_{attack_name}_{cfg.agg}"
+    if cfg.noise_var is not None:
+        title += f"_{cfg.noise_var}"
+    if cfg.mark:
+        title += f"_{cfg.mark}"
+    return title
+
+
+def cache_path(cfg: FedConfig, dataset_name: str) -> str:
+    cache_dir = cfg.cache_dir or f"./{dataset_name.upper()}_Air_weight_tpu/"
+    os.makedirs(cache_dir, exist_ok=True)
+    prefix = f"{dataset_name}_K{cfg.node_size}_B{cfg.byz_size}_"
+    return os.path.join(cache_dir, prefix + run_title(cfg))
+
+
+def banner(cfg: FedConfig, trainer: FedTrainer, path: str):
+    n_params = trainer.dim
+    if n_params >= 2**20:
+        p_str = f"{n_params / 2**20:.2f}M"
+    elif n_params >= 2**10:
+        p_str = f"{n_params / 2**10:.2f}K"
+    else:
+        p_str = str(n_params)
+    attack_name = cfg.attack if cfg.attack is not None else "baseline"
+    ds = trainer.dataset
+    print(f"[submit task ] {path}")
+    print("[running info]")
+    print(f"[network info]   name={cfg.model} parameters number={p_str}")
+    print(
+        f"[optimization]   name={cfg.opt} aggregation={cfg.agg} attack={attack_name}"
+    )
+    print(
+        f"[dataset info] name={ds.name} source={ds.source} "
+        f"trainSize={len(ds.x_train)} validationSize={len(ds.x_val)}"
+    )
+    print(
+        f"[optimizer   ] gamma={cfg.gamma} weight_decay={cfg.weight_decay} "
+        f"batchSize={cfg.batch_size}"
+    )
+    print(
+        f"[node number ]   honestSize={cfg.honest_size}, byzantineSize={cfg.byz_size}"
+    )
+    print(
+        f"[running time]   rounds={cfg.rounds}, displayInterval={cfg.display_interval}"
+    )
+    import jax
+
+    print(
+        f"[jax set     ]  backend={jax.default_backend()} devices={len(jax.devices())} "
+        f"SEED={cfg.seed}, fixSeed={cfg.fix_seed}"
+    )
+    print("-------------------------------------------")
+
+
+def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
+    """Build a trainer, run the full schedule, pickle the record.
+
+    Mirrors reference ``run`` (``:427-492``): when no attack is given the
+    Byzantine count is zeroed (``:430-431``)."""
+    if cfg.attack is None:
+        cfg.byz_size = 0
+    cfg.validate()
+
+    from ..registry import OPTIMIZERS
+
+    trainer_cls = OPTIMIZERS.get(cfg.opt)
+    trainer = trainer_cls(cfg)
+    path = cache_path(cfg, trainer.dataset.name)
+    banner(cfg, trainer, path)
+
+    # checkpoint / resume (the reference's --inherit was dead; :22,:500)
+    start_round = 0
+    checkpoint_fn = None
+    title = run_title(cfg)
+    if cfg.checkpoint_dir:
+        checkpoint_fn = lambda r, t: checkpoint.save(
+            cfg.checkpoint_dir, title, r, t.flat_params
+        )
+        if cfg.inherit:
+            restored = checkpoint.load(cfg.checkpoint_dir, title)
+            if restored is not None:
+                start_round, flat = restored
+                trainer.flat_params = jnp.asarray(flat)
+                log(f"Resumed from checkpoint at round {start_round}")
+
+    log("Optimization begin")
+    t0 = time.perf_counter()
+    paths = trainer.train(
+        log_fn=log, checkpoint_fn=checkpoint_fn, start_round=start_round
+    )
+    elapsed = time.perf_counter() - t0
+    rps = (cfg.rounds - start_round) / max(elapsed, 1e-9)
+    log(f"Optimization done in {elapsed:.1f}s ({rps:.2f} rounds/sec)")
+
+    record = {
+        # dataset config block (reference dataSetConfig, :536-541)
+        "name": trainer.dataset.name,
+        "dataSet": trainer.dataset.name,
+        "dataSetSize": len(trainer.dataset.x_train),
+        "maxFeature": int(
+            trainer.dataset.x_train[0].size
+        ),
+        # config block with callables already as names (reference :474-479)
+        "honestSize": cfg.honest_size,
+        "byzantineSize": cfg.byz_size,
+        "rounds": cfg.rounds,
+        "displayInterval": cfg.display_interval,
+        "weight_decay": cfg.weight_decay,
+        "fixSeed": cfg.fix_seed,
+        "SEED": cfg.seed,
+        "batchSize": cfg.batch_size,
+        "gamma": cfg.gamma,
+        "aggregate": cfg.agg,
+        "attack": cfg.attack,
+        "noise_var": cfg.noise_var,
+        "model": cfg.model,
+        # metric paths (reference :481-489)
+        "trainLossPath": paths["trainLossPath"],
+        "trainAccPath": paths["trainAccPath"],
+        "valLossPath": paths["valLossPath"],
+        "valAccPath": paths["valAccPath"],
+        "variencePath": paths["variencePath"],
+        # framework extras
+        "roundsPerSec": paths["roundsPerSec"],
+    }
+    if record_in_file:
+        with open(path, "wb") as f:
+            pickle.dump(record, f)
+    return record

@@ -1,0 +1,320 @@
+"""The federated training engine — the hot path.
+
+Re-design of the reference's ``SGD`` round loop
+(``/root/reference/MNIST_Air_weight.py:226-372``).  The reference
+time-multiplexes K clients through one shared model in a Python ``for`` loop
+(``:291``), snapshotting/restoring ``state_dict`` around every client and
+copying each client's weights to the CPU (``:304``).  Here the whole global
+iteration is ONE pure function:
+
+    flat_params [d] --vmap over K clients--> weight stack [K, d]
+      --message attack--> --channel--> --robust aggregate--> flat_params'
+
+and ``display_interval`` iterations are rolled into a single jitted
+``lax.scan``, so a full "round" (10 global iterations in the reference
+config) is one XLA program with no host round-trips.  Per-client gradients
+are taken w.r.t. the *flat* parameter vector directly, so the [K, d] stack
+is produced by the vmapped grad with no per-parameter Python plumbing.
+
+Semantics mirrored exactly (see SURVEY.md §3.2):
+ * one local SGD step per client per iteration: w <- w - gamma*(g + wd*w)
+   (``:302-303``)
+ * data-level attacks inside the client step, selected by a static per-client
+   Byzantine mask (last ``byz_size`` clients, ``:291-341``)
+ * message attack on the stacked [K, d] (``:346-347``)
+ * channel dispatch: OMA pre-pass for every aggregator except ``gm`` when
+   noise_var is set (``:351-352``)
+ * aggregator guess seeded with the pre-iteration global params (``:349-350``)
+ * per-round honest-client dispersion metric (``:360-361``)
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..data import datasets as data_lib
+from ..ops import aggregators as agg_lib
+from ..ops import attacks as attack_lib
+from ..ops import channel as channel_lib
+from ..ops import flatten as flatten_lib
+from ..registry import DATASETS, MODELS
+from .config import FedConfig
+
+
+def cross_entropy(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+def honest_variance(w_stack: jnp.ndarray, honest_size: int) -> jnp.ndarray:
+    """Mean over honest clients of ||w_i - mean_honest||^2
+    (reference ``getVarience``, ``:127-129``)."""
+    w_h = w_stack[:honest_size]
+    centered = w_h - jnp.mean(w_h, axis=0, keepdims=True)
+    return jnp.mean(jnp.sum(centered**2, axis=1))
+
+
+@dataclass
+class RoundMetrics:
+    train_loss: float
+    train_acc: float
+    val_loss: float
+    val_acc: float
+    variance: Optional[float] = None
+
+
+class FedTrainer:
+    """Builds and drives the jitted federated round program.
+
+    Single-device by default; the sharded multi-chip variant lives in
+    ``..parallel`` and reuses the same pure round function.
+    """
+
+    def __init__(
+        self,
+        cfg: FedConfig,
+        dataset: Optional[data_lib.Dataset] = None,
+        shard_fn: Optional[Callable] = None,
+    ):
+        self.cfg = cfg.validate()
+        self.dataset = dataset or data_lib.load(
+            cfg.dataset
+        )
+        self.attack = attack_lib.resolve(cfg.attack)
+        self.agg_fn = agg_lib.resolve(cfg.agg)
+        self.num_classes = self.dataset.num_classes
+
+        model_kw = dict(num_classes=self.num_classes)
+        if cfg.model == "CNN":
+            model_kw["fc_width"] = cfg.fc_width
+        self.model = MODELS.get(cfg.model)(**model_kw)
+
+        # init params (reference modelFactory + setup_seed(2021), :98-104)
+        sample = jnp.zeros((1,) + self.dataset.input_shape, jnp.float32)
+        params = self.model.init(jax.random.PRNGKey(cfg.seed), sample)
+        self.spec = flatten_lib.make_flat_spec(params)
+        self.flat_params = flatten_lib.flatten(params, self.spec)
+        self.dim = self.spec.total
+
+        # device-resident data
+        self.x_train = jnp.asarray(self.dataset.x_train)
+        self.y_train = jnp.asarray(self.dataset.y_train)
+        sharding = data_lib.contiguous_shards(len(self.dataset.x_train), cfg.node_size)
+        self.offsets = jnp.asarray(sharding.offsets)
+        self.sizes = jnp.asarray(sharding.sizes)
+
+        # static per-client Byzantine mask: LAST byz_size clients (:291)
+        mask = np.zeros(cfg.node_size, bool)
+        if cfg.byz_size:
+            mask[-cfg.byz_size :] = True
+        self.byz_mask = jnp.asarray(mask)
+
+        # optional sharding hook (applied by the parallel layer)
+        self._shard_fn = shard_fn
+
+        self._round_fn = jax.jit(self._build_round_fn())
+        self._eval_fn = jax.jit(self._build_eval_fn())
+        self._eval_cache: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------
+    # pure functions
+
+    def _per_client_grad(self, flat_params, x_k, y_k, is_byz):
+        """Gradient of the mean CE loss w.r.t. the FLAT param vector for one
+        client's batch, with data-level attack applied under the mask."""
+        cfg = self.cfg
+        if self.attack is not None and self.attack.data_fn is not None:
+            x_att, y_att = self.attack.apply_data(x_k, y_k, self.num_classes)
+            x_k = jnp.where(is_byz, x_att, x_k)
+            y_k = jnp.where(is_byz, y_att, y_k)
+
+        def loss(fp):
+            params = flatten_lib.unflatten(fp, self.spec)
+            logits = self.model.apply(params, x_k)
+            return jnp.mean(cross_entropy(logits, y_k))
+
+        return jax.grad(loss)(flat_params)
+
+    def _iteration(self, flat_params, key):
+        """One global iteration: local steps -> attack -> channel -> agg."""
+        cfg = self.cfg
+        k_batch, k_chan, k_agg, k_msg = jax.random.split(key, 4)
+
+        idx = data_lib.sample_client_batch_indices(
+            k_batch, self.offsets, self.sizes, cfg.batch_size
+        )
+        x = self.x_train[idx]  # [K, B, ...] on-device gather
+        y = self.y_train[idx]
+
+        grads = jax.vmap(self._per_client_grad, in_axes=(None, 0, 0, 0))(
+            flat_params, x, y, self.byz_mask
+        )  # [K, d]
+
+        if self.attack is not None and self.attack.grad_scale != 1.0:
+            scale = jnp.where(self.byz_mask, self.attack.grad_scale, 1.0)
+            grads = grads * scale[:, None]
+
+        # one local SGD step from the shared global params (:302-303)
+        w_stack = flat_params[None, :] - cfg.gamma * (
+            grads + cfg.weight_decay * flat_params[None, :]
+        )
+
+        if self.attack is not None:
+            w_stack = self.attack.apply_message(w_stack, cfg.byz_size, k_msg)
+
+        if cfg.noise_var is not None and agg_lib.needs_oma_prepass(cfg.agg):
+            w_stack = channel_lib.oma(k_chan, w_stack, cfg.noise_var)
+
+        new_flat = self.agg_fn(
+            w_stack,
+            honest_size=cfg.honest_size,
+            key=k_agg,
+            noise_var=cfg.noise_var,
+            guess=flat_params,
+            maxiter=cfg.agg_maxiter,
+            tol=cfg.agg_tol,
+            p_max=cfg.gm_p_max,
+        )
+        variance = honest_variance(w_stack, cfg.honest_size)
+        return new_flat, variance
+
+    def _build_round_fn(self):
+        def round_fn(flat_params, round_key):
+            keys = jax.random.split(round_key, self.cfg.display_interval)
+
+            def step(fp, k):
+                if self._shard_fn is not None:
+                    fp = self._shard_fn(fp)
+                return self._iteration(fp, k)
+
+            final, variances = jax.lax.scan(step, flat_params, keys)
+            return final, variances[-1]
+
+        return round_fn
+
+    def _build_eval_fn(self):
+        eval_b = self.cfg.eval_batch
+
+        def eval_fn(flat_params, x_chunks, y_chunks, m_chunks):
+            params = flatten_lib.unflatten(flat_params, self.spec)
+
+            def chunk(carry, args):
+                xc, yc, mc = args
+                logits = self.model.apply(params, xc)
+                losses = cross_entropy(logits, yc) * mc
+                correct = (jnp.argmax(logits, axis=1) == yc) * mc
+                return carry, (jnp.sum(losses), jnp.sum(correct))
+
+            _, (losses, corrects) = jax.lax.scan(
+                chunk, 0, (x_chunks, y_chunks, m_chunks)
+            )
+            total = jnp.sum(m_chunks)
+            return jnp.sum(losses) / total, jnp.sum(corrects) / total
+
+        return eval_fn
+
+    # ------------------------------------------------------------------
+    # host-side driver
+
+    def _chunked(self, x: np.ndarray, y: np.ndarray):
+        b = self.cfg.eval_batch
+        n = len(x)
+        n_pad = (-n) % b
+        xp = np.concatenate([x, np.zeros((n_pad,) + x.shape[1:], x.dtype)])
+        yp = np.concatenate([y, np.zeros((n_pad,), y.dtype)])
+        mp = np.concatenate([np.ones(n, np.float32), np.zeros(n_pad, np.float32)])
+        shape = (-1, b)
+        return (
+            jnp.asarray(xp.reshape(shape + x.shape[1:])),
+            jnp.asarray(yp.reshape(shape)),
+            jnp.asarray(mp.reshape(shape)),
+        )
+
+    def evaluate(self, split: str = "val"):
+        """Full-dataset loss/accuracy (reference ``calculateAccuracy``,
+        ``:106-125``), chunked so CNN activations fit on chip."""
+        if split not in self._eval_cache:
+            ds = self.dataset
+            arrs = (ds.x_val, ds.y_val) if split == "val" else (ds.x_train, ds.y_train)
+            self._eval_cache[split] = self._chunked(*arrs)
+        x, y, m = self._eval_cache[split]
+        loss, acc = self._eval_fn(self.flat_params, x, y, m)
+        return float(loss), float(acc)
+
+    def run_round(self, round_idx: int) -> float:
+        """Execute one round (display_interval global iterations); returns the
+        honest-dispersion metric of the round's last iteration."""
+        round_key = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), round_idx)
+        self.flat_params, variance = self._round_fn(self.flat_params, round_key)
+        return float(variance)
+
+    def train(
+        self,
+        log_fn: Optional[Callable[[str], None]] = None,
+        checkpoint_fn: Optional[Callable[[int, "FedTrainer"], None]] = None,
+        start_round: int = 0,
+    ) -> Dict[str, List[float]]:
+        """Full training run; returns reference-schema metric paths
+        (``trainLossPath`` etc., pickled record keys at ``:481-489``).
+        ``start_round > 0`` resumes a checkpointed run: per-round keys are
+        derived by ``fold_in(seed, round)``, so the remaining rounds replay
+        identically to an uninterrupted run."""
+        cfg = self.cfg
+        log = log_fn or (lambda s: None)
+
+        def eval_pair():
+            if cfg.eval_train:
+                tr = self.evaluate("train")
+            else:
+                tr = (0.0, 0.0)  # EMNIST reference stubs train eval (:273-274)
+            va = self.evaluate("val")
+            return tr, va
+
+        (tr_loss, tr_acc), (va_loss, va_acc) = eval_pair()
+        paths = {
+            "trainLossPath": [tr_loss],
+            "trainAccPath": [tr_acc],
+            "valLossPath": [va_loss],
+            "valAccPath": [va_acc],
+            "variencePath": [],  # sic — reference spelling, draw.ipynb consumes it
+            "roundsPerSec": [],
+        }
+        log(
+            f"[0/{cfg.rounds}](interval: {cfg.display_interval}) "
+            f"train: loss={tr_loss:.4f} acc={tr_acc:.4f} "
+            f"val: loss={va_loss:.4f} acc={va_acc:.4f}"
+        )
+
+        for r in range(start_round, cfg.rounds):
+            t0 = time.perf_counter()
+            variance = self.run_round(r)
+            jax.block_until_ready(self.flat_params)
+            dt = time.perf_counter() - t0
+            (tr_loss, tr_acc), (va_loss, va_acc) = eval_pair()
+            paths["trainLossPath"].append(tr_loss)
+            paths["trainAccPath"].append(tr_acc)
+            paths["valLossPath"].append(va_loss)
+            paths["valAccPath"].append(va_acc)
+            paths["variencePath"].append(variance)
+            paths["roundsPerSec"].append(1.0 / dt)
+            var_str = (
+                f" var={cfg.noise_var:.2e}" if cfg.noise_var is not None else ""
+            )
+            log(
+                f"[{r + 1}/{cfg.rounds}](interval: {cfg.display_interval}) "
+                f"train: loss={tr_loss:.4f} acc={tr_acc:.4f} "
+                f"val: loss={va_loss:.4f} acc={va_acc:.4f}{var_str}"
+            )
+            if checkpoint_fn is not None:
+                checkpoint_fn(r + 1, self)
+        return paths
+
+    @property
+    def params(self):
+        return flatten_lib.unflatten(self.flat_params, self.spec)

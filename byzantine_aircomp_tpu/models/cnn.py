@@ -1,0 +1,61 @@
+"""CNN matching the reference architecture.
+
+Reference ``CNN`` (``/root/reference/MNIST_Air_weight.py:63-90``):
+conv(1->32, 5x5, pad 2) + ReLU + maxpool2  ->  conv(32->64, 5x5, pad 2) +
+ReLU + maxpool2  ->  fc(64*7*7 -> fc_width) + ReLU  ->  fc(fc_width -> C).
+MNIST: fc_width=1024, C=10 (3,274,634 params).  EMNIST byclass: fc_width=2048,
+C=62 (``EMNIST_Air_weight.py:80-82``).
+
+Layout is NHWC (TPU-native) rather than the reference's NCHW; XLA maps the
+5x5 convs onto the MXU.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..registry import MODELS
+from .initializers import bias_001, xavier_normal_relu
+
+
+class CNN(nn.Module):
+    num_classes: int = 10
+    fc_width: int = 1024
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]  # [B, H, W] -> [B, H, W, 1]
+        conv = lambda feat: nn.Conv(
+            feat,
+            kernel_size=(5, 5),
+            padding=2,
+            kernel_init=xavier_normal_relu(),
+            bias_init=bias_001,
+            dtype=jnp.float32,
+        )
+        x = conv(32)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = conv(64)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(
+            self.fc_width,
+            kernel_init=xavier_normal_relu(),
+            bias_init=bias_001,
+        )(x)
+        x = nn.relu(x)
+        return nn.Dense(
+            self.num_classes,
+            kernel_init=xavier_normal_relu(),
+            bias_init=bias_001,
+        )(x)
+
+
+@MODELS.register("CNN", aliases=("cnn",))
+def make_cnn(num_classes: int = 10, fc_width: int = 1024, **_):
+    # EMNIST variant widens fc1 to 2048 (EMNIST_Air_weight.py:80-82)
+    return CNN(num_classes=num_classes, fc_width=fc_width)

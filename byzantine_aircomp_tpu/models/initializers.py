@@ -1,0 +1,31 @@
+"""Weight initialization matching the reference.
+
+The reference initializes every Conv/Linear with xavier-normal scaled by the
+relu gain (sqrt(2)) and constant bias 0.01
+(``/root/reference/MNIST_Air_weight.py:92-95``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax.nn import initializers as jinit
+
+RELU_GAIN = math.sqrt(2.0)
+
+
+def xavier_normal_relu(gain: float = RELU_GAIN):
+    """Xavier-normal with gain: std = gain * sqrt(2 / (fan_in + fan_out)).
+
+    Equivalent to ``variance_scaling`` with scale = gain^2, fan_avg, normal —
+    matching ``nn.init.xavier_normal_(w, gain=calculate_gain('relu'))``.
+    """
+    return jinit.variance_scaling(
+        scale=gain * gain, mode="fan_avg", distribution="normal"
+    )
+
+
+def bias_001(key, shape, dtype=jnp.float32):
+    """Constant 0.01 bias (reference ``nn.init.constant_(m.bias, 0.01)``)."""
+    return jnp.full(shape, 0.01, dtype)

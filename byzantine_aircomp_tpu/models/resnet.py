@@ -1,0 +1,98 @@
+"""ResNet-18 (CIFAR-10 variant) for the BASELINE.json scale-up config.
+
+Not present in the reference (its ``CNN`` is the largest model,
+``/root/reference/MNIST_Air_weight.py:63-90``); BASELINE.json's config 5
+targets "CIFAR-10 ResNet-18, K=1000, B=100".  Design choices for federated
+TPU training:
+
+* **GroupNorm instead of BatchNorm** — BN's running statistics don't commute
+  with weight-space aggregation across clients (each client would carry its
+  own stats, and robust aggregators like Krum would mix them incoherently);
+  GroupNorm is stateless and is the standard substitution in federated
+  vision models.
+* CIFAR stem: 3x3 conv, no max-pool (standard ResNet-18-CIFAR).
+* NHWC layout, bfloat16-friendly compute path via the ``dtype`` attribute.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..registry import MODELS
+from .initializers import bias_001, xavier_normal_relu
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(
+            nn.Conv,
+            kernel_size=(3, 3),
+            use_bias=False,
+            kernel_init=xavier_normal_relu(),
+            dtype=self.dtype,
+        )
+        norm = partial(nn.GroupNorm, num_groups=8, dtype=self.dtype)
+
+        residual = x
+        y = conv(self.features, strides=(self.strides, self.strides))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.features)(y)
+        y = norm()(y)
+
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.features,
+                kernel_size=(1, 1),
+                strides=(self.strides, self.strides),
+                use_bias=False,
+                kernel_init=xavier_normal_relu(),
+                dtype=self.dtype,
+            )(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet18(nn.Module):
+    num_classes: int = 10
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(
+            64,
+            kernel_size=(3, 3),
+            use_bias=False,
+            kernel_init=xavier_normal_relu(),
+            dtype=self.dtype,
+        )(x)
+        x = nn.GroupNorm(num_groups=8, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        for i, block_count in enumerate(self.stage_sizes):
+            features = 64 * 2**i
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BasicBlock(features, strides=strides, dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(
+            self.num_classes,
+            kernel_init=xavier_normal_relu(),
+            bias_init=bias_001,
+        )(x.astype(jnp.float32))
+
+
+@MODELS.register("ResNet18", aliases=("resnet18",))
+def make_resnet18(num_classes: int = 10, dtype=jnp.float32, **_):
+    return ResNet18(num_classes=num_classes, dtype=dtype)

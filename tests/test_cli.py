@@ -1,0 +1,100 @@
+"""CLI surface tests: reference flag compatibility + registry dispatch."""
+
+import numpy as np
+
+from byzantine_aircomp_tpu.cli import build_parser, config_from_args
+
+
+def parse(argv):
+    return config_from_args(build_parser().parse_args(argv))
+
+
+def test_defaults_match_reference():
+    # reference defaults: opt SGD, agg gm, no attack, no var (:16-28),
+    # honestSize 50, rounds 100, interval 10, batch 50, seed 2021 (:516-530),
+    # gamma 1e-2 (:543-544)
+    cfg = parse([])
+    assert cfg.agg == "gm"
+    assert cfg.attack is None
+    assert cfg.noise_var is None
+    assert cfg.honest_size == 50 and cfg.byz_size == 0
+    assert cfg.rounds == 100 and cfg.display_interval == 10
+    assert cfg.batch_size == 50 and cfg.gamma == 1e-2
+    assert cfg.seed == 2021
+
+
+def test_k_b_override():
+    # --K/--B: honestSize = K - B (:531-533)
+    cfg = parse(["--K", "50", "--B", "5"])
+    assert cfg.honest_size == 45 and cfg.byz_size == 5
+
+
+def test_reference_readme_commands_parse():
+    # every README.md:17-31 reproduction command parses
+    for argv in [
+        ["--agg", "gm2"],
+        ["--agg", "gm2", "--attack", "classflip", "--K", "50", "--B", "5"],
+        ["--agg", "gm2", "--attack", "classflip", "--K", "50", "--B", "10"],
+        ["--var", "1e-2"],
+        ["--var", "1e-2", "--attack", "classflip", "--K", "50", "--B", "5"],
+        ["--agg", "gm2", "--attack", "weightflip", "--K", "50", "--B", "10"],
+        ["--use-gpu", "True", "--mark", "X"],
+    ]:
+        cfg = parse(argv)
+        assert cfg.byz_size == 0 or cfg.attack is not None
+
+
+def test_title_scheme():
+    from byzantine_aircomp_tpu.fed.harness import run_title
+
+    cfg = parse(["--agg", "gm2", "--attack", "classflip", "--K", "50", "--B", "5"])
+    assert run_title(cfg) == "MLP_SGD_classflip_gm2"
+    cfg = parse(["--var", "0.01"])
+    assert run_title(cfg) == "MLP_SGD_baseline_gm_0.01"
+    cfg = parse(["--mark", "X"])
+    assert run_title(cfg) == "MLP_SGD_baseline_gm_X"
+
+
+def test_end_to_end_tiny_run(tmp_path):
+    # full CLI -> harness -> trainer -> pickled record
+    import pickle
+
+    from byzantine_aircomp_tpu.cli import main
+
+    record = main(
+        [
+            "--agg",
+            "mean",
+            "--K",
+            "6",
+            "--B",
+            "0",
+            "--rounds",
+            "1",
+            "--interval",
+            "2",
+            "--batch-size",
+            "16",
+            "--no-eval-train",
+            "--cache-dir",
+            str(tmp_path) + "/",
+        ]
+    )
+    assert len(record["valAccPath"]) == 2
+    assert record["aggregate"] == "mean"
+    # pickle written with reference-compatible keys
+    files = list(tmp_path.iterdir())
+    assert len(files) == 1
+    with open(files[0], "rb") as f:
+        loaded = pickle.load(f)
+    for key in [
+        "trainLossPath",
+        "trainAccPath",
+        "valLossPath",
+        "valAccPath",
+        "variencePath",
+        "SEED",
+        "batchSize",
+        "displayInterval",
+    ]:
+        assert key in loaded

@@ -1,0 +1,77 @@
+"""Data layer: sharding math, sampling, synthetic dataset properties."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from byzantine_aircomp_tpu import data
+
+
+def test_contiguous_shards_match_reference_math():
+    # pieces[i] = floor(i*N/K) (MNIST_Air_weight.py:238-239)
+    n, k = 60000, 7
+    sh = data.contiguous_shards(n, k)
+    pieces = [(i * n) // k for i in range(k + 1)]
+    np.testing.assert_array_equal(sh.offsets, pieces[:-1])
+    np.testing.assert_array_equal(sh.sizes, np.diff(pieces))
+    assert sh.sizes.sum() == n
+    assert sh.num_clients == k
+
+
+def test_sample_indices_within_shards():
+    n, k, b = 1000, 13, 32
+    sh = data.contiguous_shards(n, k)
+    idx = data.sample_client_batch_indices(
+        jax.random.PRNGKey(0), jnp.asarray(sh.offsets), jnp.asarray(sh.sizes), b
+    )
+    idx = np.asarray(idx)
+    assert idx.shape == (k, b)
+    for i in range(k):
+        assert (idx[i] >= sh.offsets[i]).all()
+        assert (idx[i] < sh.offsets[i] + sh.sizes[i]).all()
+
+
+def test_sample_indices_cover_shard():
+    # with replacement over a small shard, most indices appear over many draws
+    sh = data.contiguous_shards(40, 2)
+    keys = jax.random.split(jax.random.PRNGKey(1), 50)
+    seen = set()
+    for kk in keys:
+        idx = np.asarray(
+            data.sample_client_batch_indices(
+                kk, jnp.asarray(sh.offsets), jnp.asarray(sh.sizes), 8
+            )
+        )
+        seen.update(idx[0].tolist())
+    assert len(seen) >= 15  # client 0 owns 20 indices
+
+
+def test_synthetic_mnist_properties():
+    ds = data.load("mnist", synthetic_train=2000, synthetic_val=500)
+    assert ds.source == "synthetic"
+    assert ds.x_train.shape == (2000, 28, 28)
+    assert ds.y_train.shape == (2000,)
+    assert ds.num_classes == 10
+    assert ds.x_train.dtype == np.float32
+    assert set(np.unique(ds.y_train)) <= set(range(10))
+    # deterministic
+    ds2 = data.load("mnist", synthetic_train=2000, synthetic_val=500)
+    np.testing.assert_array_equal(ds.x_train, ds2.x_train)
+
+
+def test_synthetic_emnist_and_cifar():
+    ds = data.load("emnist", synthetic_train=1000, synthetic_val=200)
+    assert ds.num_classes == 62 and ds.x_train.shape[1:] == (28, 28)
+    ds = data.load("cifar10", synthetic_train=1000, synthetic_val=200)
+    assert ds.num_classes == 10 and ds.x_train.shape[1:] == (32, 32, 3)
+
+
+def test_synthetic_is_learnable():
+    # a least-squares linear probe must beat chance comfortably
+    ds = data.load("mnist", synthetic_train=2000, synthetic_val=500)
+    x = ds.x_train.reshape(len(ds.x_train), -1)
+    y = np.eye(10)[ds.y_train]
+    w, *_ = np.linalg.lstsq(x, y, rcond=None)
+    pred = ds.x_val.reshape(len(ds.x_val), -1) @ w
+    acc = (pred.argmax(1) == ds.y_val).mean()
+    assert acc > 0.5

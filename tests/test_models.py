@@ -1,0 +1,83 @@
+"""Model parity tests: param counts and init statistics vs the reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from byzantine_aircomp_tpu import MODELS
+from byzantine_aircomp_tpu.ops import flatten as fl
+
+
+def _n_params(params):
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+
+
+def test_mlp_param_count_mnist():
+    # 784*10 + 10 = 7,850 (SURVEY.md §2.4)
+    model = MODELS.get("MLP")(num_classes=10)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28)))
+    assert _n_params(params) == 7850
+
+
+def test_mlp_param_count_emnist():
+    # 784*62 + 62 = 48,670
+    model = MODELS.get("MLP")(num_classes=62)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28)))
+    assert _n_params(params) == 48670
+
+
+def test_cnn_param_count_mnist():
+    # 3,274,634 params (SURVEY.md §2.4)
+    model = MODELS.get("CNN")(num_classes=10, fc_width=1024)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28)))
+    assert _n_params(params) == 3274634
+
+
+def test_cnn_param_count_emnist():
+    # EMNIST widths: fc1 2048, 62 classes -> 6,603,710 params
+    model = MODELS.get("CNN")(num_classes=62, fc_width=2048)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28)))
+    assert _n_params(params) == 6603710
+
+
+def test_mlp_init_statistics():
+    # xavier-normal with relu gain: std = sqrt(2)*sqrt(2/(784+10)); bias 0.01
+    model = MODELS.get("MLP")(num_classes=10)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28)))
+    kernel = params["params"]["Dense_0"]["kernel"]
+    bias = params["params"]["Dense_0"]["bias"]
+    want_std = np.sqrt(2.0) * np.sqrt(2.0 / (784 + 10))
+    assert abs(float(jnp.std(kernel)) - want_std) / want_std < 0.05
+    np.testing.assert_allclose(np.asarray(bias), 0.01)
+
+
+def test_mlp_forward_shape_and_flatten():
+    model = MODELS.get("MLP")(num_classes=10)
+    x = jnp.ones((4, 28, 28))
+    params = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (4, 10)
+    spec = fl.make_flat_spec(params)
+    v = fl.flatten(params, spec)
+    assert v.shape == (7850,)
+    back = fl.unflatten(v, spec)
+    out2 = model.apply(back, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), rtol=1e-6)
+
+
+def test_cnn_forward_shape():
+    model = MODELS.get("CNN")(num_classes=10)
+    x = jnp.ones((2, 28, 28))
+    params = model.init(jax.random.PRNGKey(0), x)
+    assert model.apply(params, x).shape == (2, 10)
+
+
+def test_resnet18_forward_shape():
+    model = MODELS.get("ResNet18")(num_classes=10)
+    x = jnp.ones((2, 32, 32, 3))
+    params = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (2, 10)
+    n = _n_params(params)
+    # ResNet-18 CIFAR ~11.2M params
+    assert 10_000_000 < n < 12_000_000

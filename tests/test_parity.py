@@ -1,0 +1,58 @@
+"""Backend parity: the JAX path vs the NumPy oracle trainer.
+
+RNG streams can't bit-match across backends (SURVEY.md hard part (b)), so
+parity is distributional: same config + seeds, final accuracy within a
+tolerance.  The north-star gate (0.5% at convergence) is checked at full
+scale by bench runs; here a scaled-down run gates gross divergence.
+"""
+
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu.backends.ref_trainer import run_ref
+from byzantine_aircomp_tpu.data import datasets as data_lib
+from byzantine_aircomp_tpu.fed.config import FedConfig
+from byzantine_aircomp_tpu.fed.train import FedTrainer
+
+
+def _cfg(**kw):
+    base = dict(
+        honest_size=10,
+        byz_size=0,
+        rounds=6,
+        display_interval=5,
+        batch_size=32,
+        agg="gm2",
+        eval_train=False,
+        agg_maxiter=100,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(agg="gm2"),
+        dict(agg="mean"),
+        dict(agg="trimmed_mean"),
+        dict(honest_size=7, byz_size=3, attack="classflip", agg="gm2"),
+        dict(honest_size=7, byz_size=3, attack="weightflip", agg="median"),
+    ],
+)
+def test_backend_parity(kw):
+    ds = data_lib.load("mnist", synthetic_train=3000, synthetic_val=600)
+    cfg_jax = _cfg(**kw)
+    cfg_ref = _cfg(**kw)
+
+    jax_paths = FedTrainer(cfg_jax, dataset=ds).train()
+    ref_paths = run_ref(cfg_ref, log_fn=lambda *a, **k: None, dataset=ds)
+
+    a = jax_paths["valAccPath"][-1]
+    b = ref_paths["valAccPath"][-1]
+    # different RNG streams -> different init luck; short runs compare loosely
+    assert abs(a - b) < 0.1, (
+        f"jax={jax_paths['valAccPath']} ref={ref_paths['valAccPath']}"
+    )
+    # both must actually learn
+    assert a > 0.45 and b > 0.45

@@ -1,0 +1,130 @@
+"""Integration tests: short end-to-end runs (SURVEY.md §4 integration strategy).
+
+Small synthetic dataset + few rounds so each config compiles and runs in
+seconds on the CPU backend; asserts learning actually happens and Byzantine
+robustness holds qualitatively.
+"""
+
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu.data import datasets as data_lib
+from byzantine_aircomp_tpu.fed.config import FedConfig
+from byzantine_aircomp_tpu.fed.train import FedTrainer
+
+
+def small_ds():
+    return data_lib.load("mnist", synthetic_train=3000, synthetic_val=600)
+
+
+def make_cfg(**kw):
+    base = dict(
+        honest_size=10,
+        byz_size=0,
+        rounds=3,
+        display_interval=5,
+        batch_size=32,
+        agg="mean",
+        eval_train=False,
+        agg_maxiter=100,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def run_short(cfg):
+    tr = FedTrainer(cfg, dataset=small_ds())
+    paths = tr.train()
+    return paths
+
+
+def test_baseline_mean_learns():
+    paths = run_short(make_cfg())
+    accs = paths["valAccPath"]
+    assert accs[-1] > 0.5, f"no learning: {accs}"
+    assert accs[-1] > accs[0] + 0.2
+
+
+def test_gm2_learns():
+    paths = run_short(make_cfg(agg="gm2"))
+    assert paths["valAccPath"][-1] > 0.5
+
+
+@pytest.mark.parametrize("agg", ["median", "trimmed_mean", "krum", "multi_krum"])
+def test_robust_aggregators_learn(agg):
+    paths = run_short(make_cfg(agg=agg))
+    assert paths["valAccPath"][-1] > 0.4, paths["valAccPath"]
+
+
+def test_gm_aircomp_learns():
+    # AirComp GM with channel noise inside each Weiszfeld step.  The receiver
+    # noise is averaged down by the client count (SNR grows with K), so the
+    # paper regime is K=50, var=1e-2; a tiny-K test needs proportionally
+    # smaller noise to stay in the learnable regime.
+    paths = run_short(make_cfg(honest_size=30, agg="gm", noise_var=1e-3, agg_maxiter=60))
+    assert paths["valAccPath"][-1] > 0.4, paths["valAccPath"]
+
+
+def test_oma_prepass_with_noise():
+    # per-client OMA corruption has a heavy-tailed post-equalization residual
+    # (1/|h|^2 under Rayleigh fading), so small-K tests use a milder variance
+    paths = run_short(make_cfg(agg="gm2", noise_var=1e-3))
+    assert paths["valAccPath"][-1] > 0.4, paths["valAccPath"]
+
+
+def test_classflip_attack_with_robust_agg():
+    # 3 of 10 Byzantine classflippers: gm2 should still learn
+    paths = run_short(
+        make_cfg(honest_size=7, byz_size=3, attack="classflip", agg="gm2")
+    )
+    assert paths["valAccPath"][-1] > 0.4, paths["valAccPath"]
+
+
+def test_weightflip_breaks_mean_but_not_gm2():
+    broken = run_short(
+        make_cfg(honest_size=7, byz_size=3, attack="weightflip", agg="mean", rounds=3)
+    )
+    robust = run_short(
+        make_cfg(honest_size=7, byz_size=3, attack="weightflip", agg="gm2", rounds=3)
+    )
+    # weightflip flips the mean direction -> mean stays near/below chance-ish,
+    # gm2 resists
+    assert robust["valAccPath"][-1] > broken["valAccPath"][-1] + 0.15, (
+        broken["valAccPath"],
+        robust["valAccPath"],
+    )
+
+
+def test_variance_metric_recorded():
+    paths = run_short(make_cfg(rounds=2))
+    assert len(paths["variencePath"]) == 2
+    assert all(v >= 0 for v in paths["variencePath"])
+
+
+def test_deterministic_given_seed():
+    a = run_short(make_cfg(rounds=2, seed=7))
+    b = run_short(make_cfg(rounds=2, seed=7))
+    np.testing.assert_allclose(a["valAccPath"], b["valAccPath"], atol=1e-6)
+
+
+def test_dataflip_runs():
+    paths = run_short(
+        make_cfg(honest_size=8, byz_size=2, attack="dataflip", agg="median", rounds=2)
+    )
+    assert len(paths["valAccPath"]) == 3
+
+
+def test_gradascent_runs():
+    paths = run_short(
+        make_cfg(honest_size=8, byz_size=2, attack="gradascent", agg="trimmed_mean", rounds=2)
+    )
+    assert len(paths["valAccPath"]) == 3
+
+
+def test_cnn_short_run():
+    cfg = make_cfg(model="CNN", rounds=1, display_interval=2, honest_size=4)
+    tr = FedTrainer(
+        cfg, dataset=data_lib.load("mnist", synthetic_train=400, synthetic_val=200)
+    )
+    paths = tr.train()
+    assert np.isfinite(paths["valLossPath"]).all()
