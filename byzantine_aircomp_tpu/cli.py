@@ -37,6 +37,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--B", type=int, default=None, help="number of Byzantine devices")
     # framework surface
     p.add_argument("--backend", choices=["jax", "ref"], default="jax")
+    p.add_argument(
+        "--sharding",
+        choices=["auto", "on", "off"],
+        default="auto",
+        help="shard clients over the device mesh (auto: when >1 device)",
+    )
+    p.add_argument(
+        "--model-parallel",
+        type=int,
+        default=None,
+        help="devices along the model (d) mesh axis",
+    )
     p.add_argument("--dataset", type=str, default="mnist")
     p.add_argument("--model", type=str, default="MLP")
     p.add_argument("--rounds", type=int, default=100)
@@ -59,6 +71,8 @@ def config_from_args(args) -> FedConfig:
         noise_var=args.var,
         checkpoint_dir=args.checkpoint_dir,
         inherit=args.inherit,
+        sharded={"auto": None, "on": True, "off": False}[args.sharding],
+        model_parallel=args.model_parallel,
         rounds=args.rounds,
         display_interval=args.interval,
         batch_size=args.batch_size,

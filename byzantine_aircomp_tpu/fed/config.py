@@ -53,6 +53,11 @@ class FedConfig:
     # federated optimizer (registry name; reference --opt, only SGD exists)
     opt: str = "SGD"
 
+    # execution layout: None = auto (shard over all devices when >1 and K
+    # divides evenly), True/False = force; model_parallel splits the d axis
+    sharded: Optional[bool] = None
+    model_parallel: Optional[int] = None
+
     # checkpoint / resume (the reference's --inherit is dead; ours works)
     checkpoint_dir: str = ""
     inherit: bool = False

@@ -88,6 +88,30 @@ def banner(cfg: FedConfig, trainer: FedTrainer, path: str):
     print("-------------------------------------------")
 
 
+def _make_trainer(cfg: FedConfig, trainer_cls):
+    """Pick the execution layout: sharded over the device mesh when it buys
+    parallelism (cfg.sharded=None is auto), single-program otherwise.  Layout
+    is orthogonal to the federated optimizer, so the sharded wrapper applies
+    to the base trainer; custom registered optimizers run as themselves."""
+    import jax
+
+    from .train import FedTrainer
+
+    n_dev = len(jax.devices())
+    if trainer_cls is FedTrainer:
+        from ..parallel import ShardedFedTrainer, mesh as mesh_lib
+
+        n_model = cfg.model_parallel or 1
+        n_clients_axis = n_dev // n_model if n_dev % n_model == 0 else 0
+        auto = n_dev > 1 and n_clients_axis and cfg.node_size % n_clients_axis == 0
+        use_sharded = auto if cfg.sharded is None else cfg.sharded
+        if use_sharded:
+            mesh = mesh_lib.make_mesh(model_parallel=cfg.model_parallel)
+            log(f"Sharded execution over mesh {dict(mesh.shape)}")
+            return ShardedFedTrainer(cfg, mesh=mesh)
+    return trainer_cls(cfg)
+
+
 def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
     """Build a trainer, run the full schedule, pickle the record.
 
@@ -100,7 +124,7 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
     from ..registry import OPTIMIZERS
 
     trainer_cls = OPTIMIZERS.get(cfg.opt)
-    trainer = trainer_cls(cfg)
+    trainer = _make_trainer(cfg, trainer_cls)
     path = cache_path(cfg, trainer.dataset.name)
     banner(cfg, trainer, path)
 

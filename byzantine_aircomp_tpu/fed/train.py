@@ -80,7 +80,6 @@ class FedTrainer:
         self,
         cfg: FedConfig,
         dataset: Optional[data_lib.Dataset] = None,
-        shard_fn: Optional[Callable] = None,
     ):
         self.cfg = cfg.validate()
         self.dataset = dataset or data_lib.load(
@@ -115,12 +114,18 @@ class FedTrainer:
             mask[-cfg.byz_size :] = True
         self.byz_mask = jnp.asarray(mask)
 
-        # optional sharding hook (applied by the parallel layer)
-        self._shard_fn = shard_fn
-
-        self._round_fn = jax.jit(self._build_round_fn())
+        self._round_fn = jax.jit(self._build_round_fn(), donate_argnums=(0,))
         self._eval_fn = jax.jit(self._build_eval_fn())
         self._eval_cache: Dict[str, Any] = {}
+
+    # sharding hooks — identity on a single device; the parallel layer
+    # (``..parallel.sharded``) overrides these with with_sharding_constraint
+    # so the SAME pure round function drives the multi-chip path.
+    def _constrain_stack(self, w_stack):
+        return w_stack
+
+    def _constrain_params(self, flat_params):
+        return flat_params
 
     # ------------------------------------------------------------------
     # pure functions
@@ -155,6 +160,7 @@ class FedTrainer:
         grads = jax.vmap(self._per_client_grad, in_axes=(None, 0, 0, 0))(
             flat_params, x, y, self.byz_mask
         )  # [K, d]
+        grads = self._constrain_stack(grads)
 
         if self.attack is not None and self.attack.grad_scale != 1.0:
             scale = jnp.where(self.byz_mask, self.attack.grad_scale, 1.0)
@@ -164,6 +170,7 @@ class FedTrainer:
         w_stack = flat_params[None, :] - cfg.gamma * (
             grads + cfg.weight_decay * flat_params[None, :]
         )
+        w_stack = self._constrain_stack(w_stack)
 
         if self.attack is not None:
             w_stack = self.attack.apply_message(w_stack, cfg.byz_size, k_msg)
@@ -181,19 +188,14 @@ class FedTrainer:
             tol=cfg.agg_tol,
             p_max=cfg.gm_p_max,
         )
+        new_flat = self._constrain_params(new_flat)
         variance = honest_variance(w_stack, cfg.honest_size)
         return new_flat, variance
 
     def _build_round_fn(self):
         def round_fn(flat_params, round_key):
             keys = jax.random.split(round_key, self.cfg.display_interval)
-
-            def step(fp, k):
-                if self._shard_fn is not None:
-                    fp = self._shard_fn(fp)
-                return self._iteration(fp, k)
-
-            final, variances = jax.lax.scan(step, flat_params, keys)
+            final, variances = jax.lax.scan(self._iteration, flat_params, keys)
             return final, variances[-1]
 
         return round_fn

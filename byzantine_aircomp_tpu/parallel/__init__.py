@@ -1,0 +1,2 @@
+from . import collective, mesh  # noqa: F401
+from .sharded import ShardedFedTrainer  # noqa: F401

@@ -1,0 +1,68 @@
+"""Device mesh construction.
+
+The scaling axes of this workload (SURVEY.md §5.7-5.8): **clients** (K — the
+reference's sequential Python loop, here a sharded array axis) and **model**
+(d — the flat parameter dimension, sharded for large models so the [K, d]
+client-weight stack fits in HBM; K=1000 x ResNet-18 is ~44 GB in fp32).
+The reference's only parallelism was intra-batch ``nn.DataParallel``
+(``MNIST_Air_weight.py:439-440``); there is no NCCL/MPI to mirror — XLA
+collectives over ICI/DCN are the communication backend.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+CLIENT_AXIS = "clients"
+MODEL_AXIS = "model"
+
+
+def factor_devices(n: int, model_parallel: Optional[int] = None) -> Tuple[int, int]:
+    """Split n devices into (clients, model) axis sizes.
+
+    Defaults to all-client parallelism (model axis 1) — the right call for
+    the paper-scale models where d is small and K is the big axis.  An
+    explicit ``model_parallel`` must divide n.
+    """
+    if model_parallel is None:
+        return n, 1
+    if n % model_parallel:
+        raise ValueError(f"model_parallel={model_parallel} must divide {n} devices")
+    return n // model_parallel, model_parallel
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None, model_parallel: Optional[int] = None
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n_c, n_m = factor_devices(len(devices), model_parallel)
+    arr = np.asarray(devices).reshape(n_c, n_m)
+    return Mesh(arr, (CLIENT_AXIS, MODEL_AXIS))
+
+
+def stack_spec() -> PartitionSpec:
+    """[K, d] client-weight stack: K over clients, d over model."""
+    return PartitionSpec(CLIENT_AXIS, MODEL_AXIS)
+
+
+def params_spec() -> PartitionSpec:
+    """[d] flat params: sharded over the model axis (replicated when the
+    model axis has size 1)."""
+    return PartitionSpec(MODEL_AXIS)
+
+
+def client_spec() -> PartitionSpec:
+    """Per-client vectors/batches: leading K axis over clients."""
+    return PartitionSpec(CLIENT_AXIS)
+
+
+def replicated() -> PartitionSpec:
+    return PartitionSpec()
+
+
+def sharding(mesh: Mesh, spec: PartitionSpec) -> NamedSharding:
+    return NamedSharding(mesh, spec)

@@ -1,0 +1,64 @@
+"""Multi-chip federated trainer.
+
+``ShardedFedTrainer`` reuses the base trainer's pure round function unchanged
+and turns it into an SPMD program over a (clients, model) mesh:
+
+* the [K, d] gradient/weight stacks carry ``with_sharding_constraint``
+  (K over the ``clients`` axis, d over ``model``), so per-client local steps
+  run fully parallel across devices;
+* the aggregated flat params are constrained to the ``model`` axis
+  (replicated when model-parallel size is 1);
+* XLA derives the collectives — the aggregators' sums become psums over ICI,
+  exactly the structure made explicit in ``.collective`` (the two paths are
+  tested against each other on the CPU mesh).
+
+This is the TPU answer to the reference's sequential K-client loop
+(``/root/reference/MNIST_Air_weight.py:291``): the reference's wall-clock
+scales O(K); here K is a mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from ..data import datasets as data_lib
+from ..fed.config import FedConfig
+from ..fed.train import FedTrainer
+from . import mesh as mesh_lib
+
+
+class ShardedFedTrainer(FedTrainer):
+    def __init__(
+        self,
+        cfg: FedConfig,
+        dataset: Optional[data_lib.Dataset] = None,
+        mesh: Optional[Mesh] = None,
+    ):
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
+        n_clients_axis = self.mesh.shape[mesh_lib.CLIENT_AXIS]
+        if cfg.node_size % n_clients_axis:
+            raise ValueError(
+                f"node_size {cfg.node_size} must be divisible by the "
+                f"'{mesh_lib.CLIENT_AXIS}' mesh axis ({n_clients_axis})"
+            )
+        super().__init__(cfg, dataset=dataset)
+
+        # lay out the device-resident state explicitly
+        repl = mesh_lib.sharding(self.mesh, mesh_lib.replicated())
+        p_shard = mesh_lib.sharding(self.mesh, mesh_lib.params_spec())
+        self.x_train = jax.device_put(self.x_train, repl)
+        self.y_train = jax.device_put(self.y_train, repl)
+        self.flat_params = jax.device_put(self.flat_params, p_shard)
+
+    def _constrain_stack(self, w_stack):
+        return jax.lax.with_sharding_constraint(
+            w_stack, mesh_lib.sharding(self.mesh, mesh_lib.stack_spec())
+        )
+
+    def _constrain_params(self, flat_params):
+        return jax.lax.with_sharding_constraint(
+            flat_params, mesh_lib.sharding(self.mesh, mesh_lib.params_spec())
+        )
