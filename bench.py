@@ -1,0 +1,89 @@
+"""North-star benchmark: federated rounds/sec at K=1000 clients, B=100
+classflip Byzantine, MNIST MLP, geometric-median aggregation.
+
+BASELINE.json target: >= 50 rounds/sec (a "round" = displayInterval = 10
+global iterations, the reference's unit at MNIST_Air_weight.py:286-287).
+``vs_baseline`` is value / 50.
+
+Prints exactly ONE JSON line on stdout; progress goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+TARGET_ROUNDS_PER_SEC = 50.0  # BASELINE.json north star (v5e-8, K=1000, B=100)
+
+K = 1000
+B = 100
+AGG = "gm2"
+ATTACK = "classflip"
+WARMUP_ROUNDS = 2
+TIMED_ROUNDS = 10
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+    from byzantine_aircomp_tpu.fed.harness import _make_trainer
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+
+    log(
+        f"bench: backend={jax.default_backend()} devices={len(jax.devices())} "
+        f"K={K} B={B} agg={AGG} attack={ATTACK}"
+    )
+
+    cfg = FedConfig(
+        honest_size=K - B,
+        byz_size=B,
+        attack=ATTACK,
+        agg=AGG,
+        rounds=WARMUP_ROUNDS + TIMED_ROUNDS,
+        display_interval=10,
+        batch_size=50,
+        eval_train=False,
+        # reference caller overrides: maxiter=1000, tol=1e-5 (:350)
+        agg_maxiter=1000,
+        agg_tol=1e-5,
+    )
+    trainer = _make_trainer(cfg, FedTrainer)
+    log(f"bench: dataset source={trainer.dataset.name}/{trainer.dataset.source} d={trainer.dim}")
+
+    for r in range(WARMUP_ROUNDS):
+        trainer.run_round(r)
+    jax.block_until_ready(trainer.flat_params)
+    log("bench: warmup done (compiled)")
+
+    t0 = time.perf_counter()
+    for r in range(WARMUP_ROUNDS, WARMUP_ROUNDS + TIMED_ROUNDS):
+        trainer.run_round(r)
+    jax.block_until_ready(trainer.flat_params)
+    dt = time.perf_counter() - t0
+    rps = TIMED_ROUNDS / dt
+
+    loss, acc = trainer.evaluate("val")
+    log(f"bench: {TIMED_ROUNDS} rounds in {dt:.3f}s -> {rps:.2f} rounds/sec "
+        f"(val_loss={loss:.4f} val_acc={acc:.4f})")
+
+    print(
+        json.dumps(
+            {
+                "metric": f"fl_rounds_per_sec_K{K}_B{B}_{ATTACK}_{AGG}_mnist_mlp",
+                "value": round(rps, 3),
+                "unit": "rounds/sec",
+                "vs_baseline": round(rps / TARGET_ROUNDS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
