@@ -20,8 +20,8 @@ K = 1000
 B = 100
 AGG = "gm2"
 ATTACK = "classflip"
-WARMUP_ROUNDS = 2
-TIMED_ROUNDS = 10
+WARMUP_ROUNDS = 3
+TIMED_ROUNDS = 50
 
 
 def log(msg: str) -> None:

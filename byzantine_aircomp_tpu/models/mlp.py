@@ -15,6 +15,10 @@ from .initializers import bias_001, xavier_normal_relu
 
 
 class MLP(nn.Module):
+    # flattens its input anyway, so the trainer may feed [B, features]
+    # directly and skip the [B, H, W] re-tiling (TPU lane-dim waste)
+    SPATIAL_INPUT = False
+
     num_classes: int = 10
 
     @nn.compact
