@@ -14,6 +14,8 @@ import json
 import sys
 import time
 
+import jax.numpy as jnp
+
 TARGET_ROUNDS_PER_SEC = 50.0  # BASELINE.json north star (v5e-8, K=1000, B=100)
 
 K = 1000
@@ -59,13 +61,16 @@ def main() -> None:
 
     for r in range(WARMUP_ROUNDS):
         trainer.run_round(r)
-    jax.block_until_ready(trainer.flat_params)
+    # a host transfer of a value derived from the params is the only honest
+    # completion barrier: on tunneled devices block_until_ready can return
+    # before the dispatched programs actually finish
+    float(jnp.sum(trainer.flat_params))
     log("bench: warmup done (compiled)")
 
     t0 = time.perf_counter()
     for r in range(WARMUP_ROUNDS, WARMUP_ROUNDS + TIMED_ROUNDS):
         trainer.run_round(r)
-    jax.block_until_ready(trainer.flat_params)
+    float(jnp.sum(trainer.flat_params))
     dt = time.perf_counter() - t0
     rps = TIMED_ROUNDS / dt
 

@@ -49,6 +49,12 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="devices along the model (d) mesh axis",
     )
+    p.add_argument(
+        "--agg-impl",
+        choices=["xla", "pallas"],
+        default="xla",
+        help="Weiszfeld step implementation (pallas = fused TPU kernel)",
+    )
     p.add_argument("--dataset", type=str, default="mnist")
     p.add_argument("--model", type=str, default="MLP")
     p.add_argument("--rounds", type=int, default=100)
@@ -72,6 +78,7 @@ def config_from_args(args) -> FedConfig:
         checkpoint_dir=args.checkpoint_dir,
         inherit=args.inherit,
         sharded={"auto": None, "on": True, "off": False}[args.sharding],
+        agg_impl=args.agg_impl,
         model_parallel=args.model_parallel,
         rounds=args.rounds,
         display_interval=args.interval,

@@ -36,6 +36,9 @@ class FedConfig:
     agg_maxiter: int = 1000
     agg_tol: float = 1e-5
     gm_p_max: float = 1.0
+    # "xla" | "pallas": geometric-median Weiszfeld step implementation
+    # (pallas = fused single-HBM-pass TPU kernel, ops/pallas_kernels.py)
+    agg_impl: str = "xla"
 
     # determinism
     seed: int = 2021
@@ -76,4 +79,7 @@ class FedConfig:
             "byz_size > 0 requires an attack"
         )
         assert self.honest_size != 0, "honest_size must be nonzero"
+        assert self.agg_impl in ("xla", "pallas"), (
+            f"agg_impl must be 'xla' or 'pallas', got {self.agg_impl!r}"
+        )
         return self

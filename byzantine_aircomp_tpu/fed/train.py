@@ -123,6 +123,10 @@ class FedTrainer:
             mask[-cfg.byz_size :] = True
         self.byz_mask = jnp.asarray(mask)
 
+        # effective Weiszfeld impl; the sharded trainer overrides this before
+        # the round fn is first traced (GSPMD cannot partition pallas_call)
+        self._agg_impl = cfg.agg_impl
+
         self._round_fn = jax.jit(self._build_round_fn(), donate_argnums=(0,))
         self._eval_fn = jax.jit(self._build_eval_fn())
         self._eval_cache: Dict[str, Any] = {}
@@ -198,6 +202,7 @@ class FedTrainer:
             maxiter=cfg.agg_maxiter,
             tol=cfg.agg_tol,
             p_max=cfg.gm_p_max,
+            impl=self._agg_impl,
         )
         new_flat = self._constrain_params(new_flat)
         variance = honest_variance(w_stack, cfg.honest_size)

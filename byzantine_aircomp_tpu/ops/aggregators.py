@@ -34,8 +34,8 @@ import jax.numpy as jnp
 
 from ..registry import AGGREGATORS
 from . import channel
-
-DIST_CLAMP = 1e-4  # reference's divide-by-zero guard, MNIST_Air_weight.py:151,:178
+from . import pallas_kernels
+from .pallas_kernels import DIST_CLAMP, GM_THRESHOLD_FACTOR
 
 
 def _centroid(wmatrix):
@@ -136,6 +136,7 @@ def gm2(
     guess: Optional[jnp.ndarray] = None,
     maxiter: int = 1000,
     tol: float = 1e-5,
+    impl: str = "xla",
     **_,
 ) -> jnp.ndarray:
     """Ideal geometric median by Weiszfeld iteration (reference ``gm2``,
@@ -143,9 +144,15 @@ def gm2(
     1e-4, stopping when the guess moves <= tol or after maxiter steps.
 
     The data-dependent early exit is a ``lax.while_loop`` so the whole solve
-    stays on device (SURVEY.md "hard parts" (a)).
+    stays on device (SURVEY.md "hard parts" (a)).  ``impl="pallas"`` runs each
+    step as the fused single-HBM-pass kernel
+    (:func:`.pallas_kernels.weiszfeld_step`) when the model fits the fused
+    regime; XLA's two-pass lowering otherwise.
     """
     init_guess = _centroid(wmatrix) if guess is None else guess
+    use_pallas = impl == "pallas" and pallas_kernels.supports_fused(
+        wmatrix.shape[1]
+    )
 
     def cond(state):
         i, _, movement = state
@@ -153,10 +160,13 @@ def gm2(
 
     def body(state):
         i, g, _ = state
-        dist = _weiszfeld_dists(wmatrix, g)
-        inv = 1.0 / dist
-        num = jnp.sum(wmatrix * inv[:, None], axis=0)
-        den = jnp.sum(inv)
+        if use_pallas:
+            num, den = pallas_kernels.weiszfeld_step(wmatrix, g)
+        else:
+            dist = _weiszfeld_dists(wmatrix, g)
+            inv = 1.0 / dist
+            num = jnp.sum(wmatrix * inv[:, None], axis=0)
+            den = jnp.sum(inv)
         g_next = num / den
         movement = jnp.linalg.norm(g - g_next)
         return i + 1, g_next, movement
@@ -177,6 +187,7 @@ def gm(
     maxiter: int = 1000,
     tol: float = 1e-5,
     p_max: float = 1.0,
+    impl: str = "xla",
     **_,
 ) -> jnp.ndarray:
     """AirComp geometric median (reference ``gm``, ``:131-160``).
@@ -188,8 +199,16 @@ def gm(
     ``guess <- noisy_num / noisy_denom * scaler`` (``:153-155``).  Because the
     iteration count is dynamic, the PRNG key rides in the while-loop carry and
     is split once per iteration.
+
+    ``impl="pallas"`` fuses distance + power control + air sums into one
+    HBM pass (:func:`.pallas_kernels.aircomp_weiszfeld_step`); fades and
+    receiver noise are drawn with the SAME key derivation as the XLA path
+    (``oma2``'s ``split(sub) -> (key_h, key_n)``), so both impls consume an
+    identical RNG stream.
     """
     init_guess = _centroid(wmatrix) if guess is None else guess
+    k_clients, d = wmatrix.shape
+    use_pallas = impl == "pallas" and pallas_kernels.supports_fused(d)
 
     def cond(state):
         i, _, movement, _ = state
@@ -199,13 +218,30 @@ def gm(
         i, g, _, k = state
         k, sub = jax.random.split(k)
         scaler = jnp.sqrt(jnp.mean(g**2))
-        dist = _weiszfeld_dists(wmatrix, g)
-        inv = (1.0 / dist)[:, None]
-        message = jnp.concatenate([wmatrix * inv, scaler * inv], axis=1)
-        noisy = channel.oma2(
-            sub, message, p_max=p_max, noise_var=noise_var, threshold=500.0 * scaler**2
-        )
-        g_next = noisy[:-1] / noisy[-1] * scaler
+        if use_pallas:
+            key_h, key_n = jax.random.split(sub)
+            h_r, h_i = channel.rayleigh_fade(key_h, k_clients)
+            num, den = pallas_kernels.aircomp_weiszfeld_step(
+                wmatrix, g, h_r**2 + h_i**2, scaler, p_max=p_max
+            )
+            if noise_var is not None:
+                scale = jnp.sqrt(jnp.asarray(noise_var, jnp.float32) / 2.0)
+                n = scale * jax.random.normal(key_n, (d + 1,), dtype=jnp.float32)
+                num = num + n[:-1]
+                den = den + n[-1]
+            g_next = num / den * scaler
+        else:
+            dist = _weiszfeld_dists(wmatrix, g)
+            inv = (1.0 / dist)[:, None]
+            message = jnp.concatenate([wmatrix * inv, scaler * inv], axis=1)
+            noisy = channel.oma2(
+                sub,
+                message,
+                p_max=p_max,
+                noise_var=noise_var,
+                threshold=GM_THRESHOLD_FACTOR * scaler**2,
+            )
+            g_next = noisy[:-1] / noisy[-1] * scaler
         movement = jnp.linalg.norm(g - g_next)
         return i + 1, g_next, movement, k
 

@@ -1,0 +1,218 @@
+"""Pallas TPU kernels for the hot [K, d] reductions.
+
+The geometric-median aggregators are the framework's headline server-side
+cost: every Weiszfeld iteration makes two passes over the [K, d] client
+stack — one to compute per-client distances ``||w_i - g||``, one to form the
+weighted sums ``sum_i w_i/d_i`` and ``sum_i 1/d_i`` (reference
+``/root/reference/MNIST_Air_weight.py:145-159`` and ``:173-183``, where the
+stack additionally lives on the *CPU*).  XLA materializes the intermediate
+and streams the stack from HBM twice; the fused kernels here keep each
+[TK, d] tile resident in VMEM and do BOTH phases per tile, so the stack is
+read from HBM exactly once per Weiszfeld iteration.
+
+Two kernels:
+
+* :func:`weiszfeld_step` — ideal step: returns ``(num [d], den [])`` with
+  ``num = sum_i w_i/d_i``, ``den = sum_i 1/d_i``, distances clamped at the
+  reference's 1e-4 guard.
+* :func:`aircomp_weiszfeld_step` — the ``gm`` aggregator's over-the-air step:
+  the per-client message is ``[w_i/d_i, scaler/d_i]`` pushed through OMA2's
+  truncated channel-inversion power control (``:396-414``); the kernel fuses
+  distance, message power ``inv_i^2 * (||w_i||^2 + scaler^2) / (d+1)``, the
+  gain, and the gain-weighted sums into the same single pass.  Fades and
+  receiver noise are drawn OUTSIDE with ``jax.random`` (tiny [K] / [d]
+  arrays), so the kernel path is bit-compatible with the XLA path's channel
+  physics and RNG stream.
+
+Both kernels pad K and d to tile boundaries with zeros and mask padded
+*rows* (padded columns are harmless: w and guess are both zero there, so
+they contribute nothing to distances or sums).  The K-tile height adapts so
+a [TK, d_padded] f32 block stays within a 4 MB VMEM budget; models whose
+flat dimension exceeds ``MAX_FUSED_DIM`` (single tile would not fit even at
+TK=8) fall back to the XLA path at the call site.
+
+CPU (tests / no-TPU) runs use ``interpret=True`` automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# shared Weiszfeld constants — single source of truth for BOTH impls
+# (aggregators.py imports these); values from the reference
+DIST_CLAMP = 1e-4  # divide-by-zero guard, MNIST_Air_weight.py:151,:178
+GM_THRESHOLD_FACTOR = 500.0  # gm power-control threshold = 500*scaler^2, :152
+LANE = 128
+VMEM_BLOCK_BUDGET = 4 * 1024 * 1024  # bytes for one [TK, Dp] f32 block
+MAX_FUSED_DIM = VMEM_BLOCK_BUDGET // (8 * 4)  # d beyond which TK=8 won't fit
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _tile_k(dp: int) -> int:
+    tk = VMEM_BLOCK_BUDGET // (dp * 4)
+    for cand in (256, 128, 64, 32, 16, 8):
+        if tk >= cand:
+            return cand
+    return 8
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() not in ("tpu",)
+
+
+def supports_fused(d: int) -> bool:
+    """Whether the single-pass kernels can hold a K-tile of width d in VMEM."""
+    return _round_up(d, LANE) <= MAX_FUSED_DIM
+
+
+def _pad2(w: jnp.ndarray, kp: int, dp: int) -> jnp.ndarray:
+    k, d = w.shape
+    return jnp.pad(w, ((0, kp - k), (0, dp - d)))
+
+
+# ---------------------------------------------------------------------------
+# ideal Weiszfeld step (gm2)
+
+
+def _weiszfeld_kernel(k_actual, tk, w_ref, g_ref, num_ref, den_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        num_ref[:] = jnp.zeros_like(num_ref)
+        den_ref[0, 0] = 0.0
+
+    w = w_ref[:]  # [TK, Dp] — the only HBM read of this tile
+    diff = w - g_ref[:]
+    sq = jnp.sum(diff * diff, axis=1, keepdims=True)  # [TK, 1]
+    dist = jnp.maximum(jnp.sqrt(sq), DIST_CLAMP)
+    inv = 1.0 / dist
+    row = i * tk + jax.lax.broadcasted_iota(jnp.int32, (tk, 1), 0)
+    inv = jnp.where(row < k_actual, inv, 0.0)
+    num_ref[:] += jnp.sum(w * inv, axis=0, keepdims=True)
+    den_ref[0, 0] += jnp.sum(inv)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def weiszfeld_step(w: jnp.ndarray, guess: jnp.ndarray, *, interpret=None):
+    """One fused ideal-Weiszfeld step over [K, d]: ``(num [d], den [])``."""
+    k, d = w.shape
+    dp = _round_up(d, LANE)
+    tk = _tile_k(dp)
+    kp = _round_up(k, tk)
+    w_p = _pad2(w, kp, dp)
+    g_p = jnp.pad(guess, (0, dp - d)).reshape(1, dp)
+    interp = _use_interpret() if interpret is None else interpret
+
+    num, den = pl.pallas_call(
+        functools.partial(_weiszfeld_kernel, k, tk),
+        grid=(kp // tk,),
+        in_specs=[
+            pl.BlockSpec((tk, dp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, dp), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interp,
+    )(w_p, g_p)
+    return num[0, :d], den[0, 0]
+
+
+# ---------------------------------------------------------------------------
+# AirComp Weiszfeld step (gm): OMA2 power control fused into the same pass
+
+
+def _aircomp_kernel(
+    k_actual, tk, d_actual, p_max, w_ref, g_ref, hsq_ref, sc_ref, num_ref, den_ref
+):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        num_ref[:] = jnp.zeros_like(num_ref)
+        den_ref[0, 0] = 0.0
+
+    scaler = sc_ref[0]
+    threshold = GM_THRESHOLD_FACTOR * scaler * scaler
+    w = w_ref[:]  # [TK, Dp] — single HBM read
+    diff = w - g_ref[:]
+    sq_dist = jnp.sum(diff * diff, axis=1, keepdims=True)  # [TK, 1]
+    sq_norm = jnp.sum(w * w, axis=1, keepdims=True)  # [TK, 1]
+    dist = jnp.maximum(jnp.sqrt(sq_dist), DIST_CLAMP)
+    inv = 1.0 / dist
+
+    # OMA2 truncated channel inversion (reference :401-407) on the message
+    # m_i = [w_i * inv_i, scaler * inv_i]  (width d+1):
+    #   mean(m_i^2) = inv_i^2 * (||w_i||^2 + scaler^2) / (d + 1)
+    p_message = inv * inv * (sq_norm + scaler * scaler) / (d_actual + 1.0)
+    p_message = p_message / hsq_ref[:]
+    gain = jnp.sqrt(p_max / jnp.maximum(p_message, threshold))  # [TK, 1]
+
+    row = i * tk + jax.lax.broadcasted_iota(jnp.int32, (tk, 1), 0)
+    coeff = jnp.where(row < k_actual, gain * inv, 0.0)  # [TK, 1]
+    num_ref[:] += jnp.sum(w * coeff, axis=0, keepdims=True)
+    den_ref[0, 0] += jnp.sum(coeff) * scaler
+
+
+@functools.partial(jax.jit, static_argnames=("p_max", "interpret"))
+def aircomp_weiszfeld_step(
+    w: jnp.ndarray,
+    guess: jnp.ndarray,
+    h_sq: jnp.ndarray,
+    scaler: jnp.ndarray,
+    *,
+    p_max: float = 1.0,
+    interpret=None,
+):
+    """One fused over-the-air Weiszfeld step: ``(num [d], den [])``.
+
+    ``num = sum_i gain_i * w_i / d_i`` and ``den = sum_i gain_i * scaler / d_i``
+    — the noiseless receiver sums of OMA2 applied to the gm message
+    (reference ``:145-155``); receiver noise is added by the caller.
+    ``h_sq`` is the per-client squared fade magnitude [K]; ``scaler`` the RMS
+    of the current guess (a traced scalar).
+    """
+    k, d = w.shape
+    dp = _round_up(d, LANE)
+    tk = _tile_k(dp)
+    kp = _round_up(k, tk)
+    w_p = _pad2(w, kp, dp)
+    g_p = jnp.pad(guess, (0, dp - d)).reshape(1, dp)
+    # padded rows get h_sq = 1 to avoid 0/0; their coeff is masked anyway
+    hsq_p = jnp.pad(h_sq.reshape(-1, 1), ((0, kp - k), (0, 0)), constant_values=1.0)
+    interp = _use_interpret() if interpret is None else interpret
+
+    num, den = pl.pallas_call(
+        functools.partial(_aircomp_kernel, k, tk, d, p_max),
+        grid=(kp // tk,),
+        in_specs=[
+            pl.BlockSpec((tk, dp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tk, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, dp), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interp,
+    )(w_p, g_p, hsq_p, scaler.reshape(1).astype(jnp.float32))
+    return num[0, :d], den[0, 0]

@@ -46,7 +46,13 @@ class ShardedFedTrainer(FedTrainer):
             )
         super().__init__(cfg, dataset=dataset)
 
-        # lay out the device-resident state explicitly
+        # GSPMD has no partitioning rule for pallas_call: with the [K, d]
+        # stack sharded over 'clients', a pallas Weiszfeld step would be
+        # compiled as an all-gather of the full stack onto every device
+        # inside the while_loop.  Force the XLA impl, whose sums partition
+        # into per-shard psums.  (Set before the round fn's first trace.)
+        if self._agg_impl == "pallas" and self.mesh.size > 1:
+            self._agg_impl = "xla"
         repl = mesh_lib.sharding(self.mesh, mesh_lib.replicated())
         p_shard = mesh_lib.sharding(self.mesh, mesh_lib.params_spec())
         self.x_train = jax.device_put(self.x_train, repl)

@@ -1,0 +1,100 @@
+"""Pallas kernel parity vs the XLA aggregator path.
+
+Runs in interpret mode on the CPU test backend (tests/conftest.py forces
+``jax_platforms=cpu``); the same kernels compile via Mosaic on TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from byzantine_aircomp_tpu.ops import aggregators as agg_lib
+from byzantine_aircomp_tpu.ops import pallas_kernels as pk
+
+
+def _stack(k=37, d=300, spread=1e-3, seed=0):
+    base = jax.random.normal(jax.random.PRNGKey(seed), (1, d)) * 0.01
+    return base + spread * jax.random.normal(jax.random.PRNGKey(seed + 1), (k, d))
+
+
+def test_weiszfeld_step_matches_xla():
+    w = _stack()
+    g = jnp.mean(w, axis=0)
+    num_p, den_p = pk.weiszfeld_step(w, g)
+    dist = jnp.maximum(pk.DIST_CLAMP, jnp.linalg.norm(w - g[None, :], axis=1))
+    num_x = jnp.sum(w / dist[:, None], axis=0)
+    den_x = jnp.sum(1.0 / dist)
+    assert jnp.allclose(num_p, num_x, atol=1e-5)
+    assert jnp.allclose(den_p, den_x, rtol=1e-6)
+
+
+@pytest.mark.parametrize("k,d", [(8, 128), (37, 300), (130, 1000), (9, 7850)])
+def test_weiszfeld_step_odd_shapes(k, d):
+    """Padding/masking must be exact for shapes off the tile grid."""
+    w = _stack(k=k, d=d)
+    g = jnp.zeros(d)
+    num_p, den_p = pk.weiszfeld_step(w, g)
+    dist = jnp.maximum(pk.DIST_CLAMP, jnp.linalg.norm(w, axis=1))
+    assert jnp.allclose(num_p, jnp.sum(w / dist[:, None], axis=0), atol=1e-5)
+    assert jnp.allclose(den_p, jnp.sum(1.0 / dist), rtol=1e-6)
+
+
+def test_gm2_pallas_matches_xla():
+    w = _stack()
+    g = jnp.mean(w, axis=0)
+    out_x = agg_lib.gm2(w, guess=g, maxiter=50, tol=1e-7, impl="xla")
+    out_p = agg_lib.gm2(w, guess=g, maxiter=50, tol=1e-7, impl="pallas")
+    assert jnp.allclose(out_x, out_p, atol=1e-6)
+
+
+@pytest.mark.parametrize("noise_var", [None, 1e-3])
+def test_gm_pallas_matches_xla(noise_var):
+    """Same RNG stream on both impls: fades and receiver noise must be drawn
+    with oma2's exact key derivation, so outputs agree to float tolerance."""
+    w = _stack()
+    g = jnp.mean(w, axis=0)
+    key = jax.random.PRNGKey(42)
+    out_x = agg_lib.gm(
+        w, key=key, noise_var=noise_var, guess=g, maxiter=30, tol=1e-7, impl="xla"
+    )
+    out_p = agg_lib.gm(
+        w, key=key, noise_var=noise_var, guess=g, maxiter=30, tol=1e-7, impl="pallas"
+    )
+    assert jnp.allclose(out_x, out_p, atol=1e-5)
+
+
+def test_fused_regime_gate():
+    assert pk.supports_fused(7850)  # MNIST MLP
+    assert pk.supports_fused(48670)  # EMNIST MLP
+    assert not pk.supports_fused(3_274_634)  # MNIST CNN -> XLA fallback
+
+
+def test_large_d_falls_back_to_xla():
+    """Beyond the fused regime gm2(impl='pallas') must still work (XLA path)."""
+    w = _stack(k=4, d=pk.MAX_FUSED_DIM + pk.LANE)
+    out = agg_lib.gm2(w, guess=jnp.mean(w, axis=0), maxiter=5, tol=1e-7, impl="pallas")
+    assert jnp.isfinite(out).all()
+
+
+def test_trainer_runs_with_pallas_impl():
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+
+    cfg = FedConfig(
+        honest_size=8,
+        byz_size=2,
+        attack="classflip",
+        agg="gm2",
+        agg_impl="pallas",
+        rounds=1,
+        display_interval=2,
+        batch_size=4,
+        eval_train=False,
+        agg_maxiter=10,
+        eval_batch=64,
+    )
+    ds = data_lib.load("mnist", synthetic_train=256, synthetic_val=64)
+    tr = FedTrainer(cfg, dataset=ds)
+    tr.run_round(0)
+    assert jnp.isfinite(tr.flat_params).all()
