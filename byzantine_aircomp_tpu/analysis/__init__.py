@@ -1,0 +1,3 @@
+"""Offline analysis of pickled run records (draw.ipynb parity)."""
+
+from .plots import find_records, load_record, paper_figure  # noqa: F401

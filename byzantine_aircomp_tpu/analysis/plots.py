@@ -1,0 +1,122 @@
+"""Offline analysis: load pickled run records, render the paper figure.
+
+Consumer of the harness record schema, replacing the reference's
+``draw.ipynb`` (``/root/reference/draw.ipynb``): cell 0 unpickles runs by the
+title convention built in ``run()`` (``MNIST_Air_weight.py:446-455,:481-492``),
+cell 1 renders a 4-panel test-loss / test-accuracy vs iteration figure
+(x = round * displayInterval).  The record keys used here
+(``valLossPath`` / ``valAccPath`` / ``variencePath`` / config scalars) are
+identical to the reference's pickle schema, so this module also reads pickles
+produced by the *reference* scripts, and the reference's notebook can read
+ours.
+
+Usage::
+
+    python -m byzantine_aircomp_tpu.analysis --cache-dir ./MNIST_Air_weight_tpu \
+        --out figure.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import matplotlib
+
+matplotlib.use("Agg")  # headless
+import matplotlib.pyplot as plt  # noqa: E402
+
+
+def load_record(path: str) -> Dict:
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def find_records(cache_dir: str, pattern: str = "*") -> Dict[str, Dict]:
+    """Load every record in ``cache_dir`` matching the glob ``pattern``;
+    returns {filename: record}."""
+    out = {}
+    for path in sorted(glob.glob(os.path.join(cache_dir, pattern))):
+        if os.path.isfile(path):
+            try:
+                out[os.path.basename(path)] = load_record(path)
+            except (pickle.UnpicklingError, EOFError):
+                continue
+    return out
+
+
+def _x_axis(record: Dict) -> List[int]:
+    interval = record.get("displayInterval", 10)
+    n = len(record["valLossPath"])
+    return [i * interval for i in range(n)]
+
+
+def plot_runs(
+    ax,
+    records: Dict[str, Dict],
+    metric: str,
+    title: str = "",
+    ylabel: str = "",
+):
+    """One panel: ``metric`` path vs global iteration for each record."""
+    for name, rec in records.items():
+        ax.plot(_x_axis(rec), rec[metric], label=name, linewidth=1.2)
+    ax.set_xlabel("iteration")
+    ax.set_ylabel(ylabel or metric)
+    if title:
+        ax.set_title(title)
+    ax.grid(True, alpha=0.3)
+    ax.legend(fontsize=7)
+
+
+def paper_figure(
+    records: Dict[str, Dict],
+    out_path: Optional[str] = None,
+    attacks: Sequence[str] = ("classflip", "weightflip"),
+):
+    """The reference paper's 4-panel figure (draw.ipynb cell 1): per attack,
+    one loss panel and one accuracy panel; every record whose ``attack``
+    field matches lands on that attack's panels, labelled by aggregator /
+    noise / Byzantine count."""
+    fig, axes = plt.subplots(1, 2 * len(attacks), figsize=(6 * len(attacks), 4.2))
+    if 2 * len(attacks) == 1:
+        axes = [axes]
+    for i, attack in enumerate(attacks):
+        sel = {
+            f"{r.get('aggregate')}"
+            + (f"_var{r['noise_var']}" if r.get("noise_var") else "_ideal")
+            + f"_B{r.get('byzantineSize', '?')}": r
+            for r in records.values()
+            if r.get("attack") == attack
+        }
+        plot_runs(axes[2 * i], sel, "valLossPath", f"{attack}: test loss", "loss")
+        plot_runs(
+            axes[2 * i + 1], sel, "valAccPath", f"{attack}: test accuracy", "accuracy"
+        )
+    fig.tight_layout()
+    if out_path:
+        fig.savefig(out_path, dpi=150)
+    return fig
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("byzantine_aircomp_tpu.analysis")
+    p.add_argument("--cache-dir", type=str, required=True)
+    p.add_argument("--pattern", type=str, default="*")
+    p.add_argument("--out", type=str, default="figure.png")
+    p.add_argument(
+        "--attacks", type=str, default="classflip,weightflip", help="comma-separated"
+    )
+    args = p.parse_args(argv)
+    records = find_records(args.cache_dir, args.pattern)
+    if not records:
+        raise SystemExit(f"no records found in {args.cache_dir}")
+    paper_figure(records, args.out, attacks=args.attacks.split(","))
+    print(f"wrote {args.out} ({len(records)} records)")
+
+
+if __name__ == "__main__":
+    main()

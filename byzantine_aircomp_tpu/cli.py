@@ -66,6 +66,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cache-dir", type=str, default="")
     p.add_argument("--no-eval-train", action="store_true")
     p.add_argument("--checkpoint-dir", type=str, default="")
+    p.add_argument(
+        "--profile-dir",
+        type=str,
+        default="",
+        help="write a jax.profiler trace of the run here",
+    )
     return p
 
 
@@ -79,6 +85,7 @@ def config_from_args(args) -> FedConfig:
         inherit=args.inherit,
         sharded={"auto": None, "on": True, "off": False}[args.sharding],
         agg_impl=args.agg_impl,
+        profile_dir=args.profile_dir,
         model_parallel=args.model_parallel,
         rounds=args.rounds,
         display_interval=args.interval,

@@ -68,6 +68,10 @@ class FedConfig:
     # misc
     mark: str = ""
     cache_dir: str = ""
+    # when set, the harness wraps the run in jax.profiler.trace(profile_dir);
+    # the round step carries named_scope phase annotations
+    # (client_local_step / message_attack / channel / aggregate)
+    profile_dir: str = ""
 
     @property
     def node_size(self) -> int:

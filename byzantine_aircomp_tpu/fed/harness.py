@@ -10,6 +10,7 @@ same record keys including the ``variencePath`` spelling (``:481-489``).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
 import sys
@@ -145,9 +146,17 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
 
     log("Optimization begin")
     t0 = time.perf_counter()
-    paths = trainer.train(
-        log_fn=log, checkpoint_fn=checkpoint_fn, start_round=start_round
-    )
+    if cfg.profile_dir:
+        import jax
+
+        profile_ctx = jax.profiler.trace(cfg.profile_dir)
+        log(f"Profiling to {cfg.profile_dir}")
+    else:
+        profile_ctx = contextlib.nullcontext()
+    with profile_ctx:
+        paths = trainer.train(
+            log_fn=log, checkpoint_fn=checkpoint_fn, start_round=start_round
+        )
     elapsed = time.perf_counter() - t0
     rps = (cfg.rounds - start_round) / max(elapsed, 1e-9)
     log(f"Optimization done in {elapsed:.1f}s ({rps:.2f} rounds/sec)")

@@ -164,47 +164,51 @@ class FedTrainer:
         cfg = self.cfg
         k_batch, k_chan, k_agg, k_msg = jax.random.split(key, 4)
 
-        idx = data_lib.sample_client_batch_indices(
-            k_batch, self.offsets, self.sizes, cfg.batch_size
-        )
-        x = self.x_train[idx]  # [K, B, features] on-device 2D gather
-        if self._spatial_input:
-            x = x.reshape(idx.shape + self._sample_shape)
-        y = self.y_train[idx]
+        with jax.named_scope("client_local_step"):
+            idx = data_lib.sample_client_batch_indices(
+                k_batch, self.offsets, self.sizes, cfg.batch_size
+            )
+            x = self.x_train[idx]  # [K, B, features] on-device 2D gather
+            if self._spatial_input:
+                x = x.reshape(idx.shape + self._sample_shape)
+            y = self.y_train[idx]
 
-        grads = jax.vmap(self._per_client_grad, in_axes=(None, 0, 0, 0))(
-            flat_params, x, y, self.byz_mask
-        )  # [K, d]
-        grads = self._constrain_stack(grads)
+            grads = jax.vmap(self._per_client_grad, in_axes=(None, 0, 0, 0))(
+                flat_params, x, y, self.byz_mask
+            )  # [K, d]
+            grads = self._constrain_stack(grads)
 
-        if self.attack is not None and self.attack.grad_scale != 1.0:
-            scale = jnp.where(self.byz_mask, self.attack.grad_scale, 1.0)
-            grads = grads * scale[:, None]
+            if self.attack is not None and self.attack.grad_scale != 1.0:
+                scale = jnp.where(self.byz_mask, self.attack.grad_scale, 1.0)
+                grads = grads * scale[:, None]
 
-        # one local SGD step from the shared global params (:302-303)
-        w_stack = flat_params[None, :] - cfg.gamma * (
-            grads + cfg.weight_decay * flat_params[None, :]
-        )
-        w_stack = self._constrain_stack(w_stack)
+            # one local SGD step from the shared global params (:302-303)
+            w_stack = flat_params[None, :] - cfg.gamma * (
+                grads + cfg.weight_decay * flat_params[None, :]
+            )
+            w_stack = self._constrain_stack(w_stack)
 
-        if self.attack is not None:
-            w_stack = self.attack.apply_message(w_stack, cfg.byz_size, k_msg)
+        with jax.named_scope("message_attack"):
+            if self.attack is not None:
+                w_stack = self.attack.apply_message(w_stack, cfg.byz_size, k_msg)
 
-        if cfg.noise_var is not None and agg_lib.needs_oma_prepass(cfg.agg):
-            w_stack = channel_lib.oma(k_chan, w_stack, cfg.noise_var)
+        with jax.named_scope("channel"):
+            if cfg.noise_var is not None and agg_lib.needs_oma_prepass(cfg.agg):
+                w_stack = channel_lib.oma(k_chan, w_stack, cfg.noise_var)
 
-        new_flat = self.agg_fn(
-            w_stack,
-            honest_size=cfg.honest_size,
-            key=k_agg,
-            noise_var=cfg.noise_var,
-            guess=flat_params,
-            maxiter=cfg.agg_maxiter,
-            tol=cfg.agg_tol,
-            p_max=cfg.gm_p_max,
-            impl=self._agg_impl,
-        )
-        new_flat = self._constrain_params(new_flat)
+        with jax.named_scope("aggregate"):
+            new_flat = self.agg_fn(
+                w_stack,
+                honest_size=cfg.honest_size,
+                key=k_agg,
+                noise_var=cfg.noise_var,
+                guess=flat_params,
+                maxiter=cfg.agg_maxiter,
+                tol=cfg.agg_tol,
+                p_max=cfg.gm_p_max,
+                impl=self._agg_impl,
+            )
+            new_flat = self._constrain_params(new_flat)
         variance = honest_variance(w_stack, cfg.honest_size)
         return new_flat, variance
 

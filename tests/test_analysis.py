@@ -1,0 +1,73 @@
+"""Offline analysis: record loading + headless figure rendering."""
+
+import os
+import pickle
+
+import pytest
+
+from byzantine_aircomp_tpu.analysis import find_records, load_record, paper_figure
+from byzantine_aircomp_tpu.analysis.plots import main as analysis_main
+
+
+def _fake_record(attack, agg, byz, noise=None, n=6, interval=10):
+    return {
+        "attack": attack,
+        "aggregate": agg,
+        "byzantineSize": byz,
+        "noise_var": noise,
+        "displayInterval": interval,
+        "valLossPath": [2.0 / (i + 1) for i in range(n)],
+        "valAccPath": [min(0.99, 0.1 + 0.15 * i) for i in range(n)],
+        "trainLossPath": [0.0] * n,
+        "trainAccPath": [0.0] * n,
+        "variencePath": [0.01] * (n - 1),
+    }
+
+
+@pytest.fixture
+def cache(tmp_path):
+    recs = {
+        "mnist_K50_B5_MLP_SGD_classflip_gm2": _fake_record("classflip", "gm2", 5),
+        "mnist_K50_B10_MLP_SGD_classflip_gm_0.01": _fake_record(
+            "classflip", "gm", 10, 0.01
+        ),
+        "mnist_K50_B5_MLP_SGD_weightflip_gm2": _fake_record("weightflip", "gm2", 5),
+    }
+    for name, rec in recs.items():
+        with open(tmp_path / name, "wb") as f:
+            pickle.dump(rec, f)
+    return tmp_path
+
+
+def test_find_and_load(cache):
+    records = find_records(str(cache))
+    assert len(records) == 3
+    one = load_record(os.path.join(str(cache), "mnist_K50_B5_MLP_SGD_classflip_gm2"))
+    assert one["attack"] == "classflip"
+    assert len(one["valAccPath"]) == 6
+
+
+def test_find_records_skips_garbage(cache):
+    (cache / "not_a_pickle").write_text("hello")
+    records = find_records(str(cache))
+    assert len(records) == 3
+
+
+def test_paper_figure_renders(cache, tmp_path):
+    records = find_records(str(cache))
+    out = str(tmp_path / "fig.png")
+    fig = paper_figure(records, out)
+    assert os.path.exists(out) and os.path.getsize(out) > 1000
+    assert len(fig.axes) == 4  # 2 attacks x (loss, acc)
+
+
+def test_cli_main(cache, tmp_path, capsys):
+    out = str(tmp_path / "fig.png")
+    analysis_main(["--cache-dir", str(cache), "--out", out])
+    assert os.path.exists(out)
+    assert "3 records" in capsys.readouterr().out
+
+
+def test_cli_main_empty_dir(tmp_path):
+    with pytest.raises(SystemExit):
+        analysis_main(["--cache-dir", str(tmp_path / "nothing"), "--out", "x.png"])
