@@ -82,16 +82,26 @@ def paper_figure(
     field matches lands on that attack's panels, labelled by aggregator /
     noise / Byzantine count."""
     fig, axes = plt.subplots(1, 2 * len(attacks), figsize=(6 * len(attacks), 4.2))
-    if 2 * len(attacks) == 1:
-        axes = [axes]
     for i, attack in enumerate(attacks):
-        sel = {
-            f"{r.get('aggregate')}"
-            + (f"_var{r['noise_var']}" if r.get("noise_var") else "_ideal")
-            + f"_B{r.get('byzantineSize', '?')}": r
-            for r in records.values()
-            if r.get("attack") == attack
-        }
+        sel: Dict[str, Dict] = {}
+        for fname, r in records.items():
+            if r.get("attack") != attack:
+                continue
+            label = (
+                f"{r.get('aggregate')}"
+                # noise_var=0.0 is a (degenerate) noisy channel, not ideal
+                + (
+                    f"_var{r['noise_var']}"
+                    if r.get("noise_var") is not None
+                    else "_ideal"
+                )
+                + f"_B{r.get('byzantineSize', '?')}"
+            )
+            if "honestSize" in r:
+                label += f"_K{r['honestSize'] + r.get('byzantineSize', 0)}"
+            while label in sel:  # runs differing only in model/seed/mark
+                label += f" [{fname}]"
+            sel[label] = r
         plot_runs(axes[2 * i], sel, "valLossPath", f"{attack}: test loss", "loss")
         plot_runs(
             axes[2 * i + 1], sel, "valAccPath", f"{attack}: test accuracy", "accuracy"

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..registry import DATASETS
+from . import native_io
 
 # normalization stats used by the reference transforms
 MNIST_STATS = (0.1307, 0.3081)  # MNIST_Air_weight.py:555
@@ -67,6 +68,9 @@ class Dataset:
 
 
 def _read_idx(path: str) -> np.ndarray:
+    native = native_io.read_idx(path)  # C++ parser (native/dataio.cpp)
+    if native is not None:
+        return native
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         magic = struct.unpack(">HBB", f.read(4))
@@ -85,6 +89,14 @@ def _find(*relpaths: str) -> Optional[str]:
     return None
 
 
+def _find_dir(name: str) -> Optional[str]:
+    for root in DATA_ROOTS:
+        cand = os.path.join(root, name)
+        if os.path.isdir(cand):
+            return cand
+    return None
+
+
 def _load_idx_pair(img_rel, lbl_rel):
     img = _find(*img_rel)
     lbl = _find(*lbl_rel)
@@ -93,8 +105,13 @@ def _load_idx_pair(img_rel, lbl_rel):
     return _read_idx(img), _read_idx(lbl)
 
 
-def _normalize(x_u8: np.ndarray, mean: float, std: float) -> np.ndarray:
-    return ((x_u8.astype(np.float32) / 255.0) - mean) / std
+def _normalize(x_u8: np.ndarray, mean, std) -> np.ndarray:
+    native = native_io.normalize_u8(x_u8, mean, std)  # parallel C++ path
+    if native is not None:
+        return native
+    m = np.asarray(mean, np.float32)
+    s = np.asarray(std, np.float32)
+    return ((x_u8.astype(np.float32) / 255.0) - m) / s
 
 
 # ---------------------------------------------------------------------------
@@ -188,14 +205,39 @@ def emnist(synthetic_train: int = 100000, synthetic_val: int = 16000, **_) -> Da
     )
 
 
+def _cifar10_from_bin() -> Optional[Dataset]:
+    """CIFAR-10 from the binary-batch distribution via the native parser."""
+    root = _find_dir("cifar-10-batches-bin")
+    if root is None:
+        return None
+    train = [
+        native_io.read_cifar_bin(os.path.join(root, f"data_batch_{i}.bin"))
+        for i in range(1, 6)
+    ]
+    test = native_io.read_cifar_bin(os.path.join(root, "test_batch.bin"))
+    if test is None or any(p is None for p in train):
+        return None
+    x_tr = np.concatenate([p[0] for p in train]).transpose(0, 2, 3, 1)
+    y_tr = np.concatenate([p[1] for p in train])
+    x_va = test[0].transpose(0, 2, 3, 1)
+    mean, std = CIFAR10_STATS
+    return Dataset(
+        "cifar10",
+        _normalize(x_tr, mean, std),
+        y_tr.astype(np.int32),
+        _normalize(x_va, mean, std),
+        test[1].astype(np.int32),
+        10,
+        "disk",
+    )
+
+
 @DATASETS.register("cifar10")
 def cifar10(synthetic_train: int = 50000, synthetic_val: int = 10000, **_) -> Dataset:
-    root = None
-    for r in DATA_ROOTS:
-        cand = os.path.join(r, "cifar-10-batches-py")
-        if os.path.isdir(cand):
-            root = cand
-            break
+    from_bin = _cifar10_from_bin()
+    if from_bin is not None:
+        return from_bin
+    root = _find_dir("cifar-10-batches-py")
     if root is not None:
         xs, ys = [], []
         for i in range(1, 6):

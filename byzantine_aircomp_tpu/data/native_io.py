@@ -1,0 +1,161 @@
+"""ctypes bindings for the native C++ data-ingestion library.
+
+The reference leans on torchvision's Python loaders for dataset IO
+(``/root/reference/MNIST_Air_weight.py:552-571``); this framework's
+equivalent runtime component is ``native/dataio.cpp`` — an OpenMP C++
+library that parses IDX (plain or gzip) and CIFAR-10 binary batches and does
+the uint8 -> normalized-float32 transform, loaded here through a plain C ABI
+(ctypes; no pybind11 in the image).
+
+Every entry point degrades gracefully: if the shared library is absent and
+cannot be built (no compiler, read-only checkout), callers get ``None`` from
+:func:`library` and fall back to the pure-NumPy implementations in
+``datasets.py``.  ``AIRCOMP_NO_NATIVE=1`` disables the native path outright.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SO_NAME = "libaircomp_dataio.so"
+_lib: Optional[ctypes.CDLL] = None
+_lib_attempted = False
+
+
+def _build() -> Optional[str]:
+    so_path = os.path.abspath(os.path.join(_NATIVE_DIR, _SO_NAME))
+    try:
+        # always invoke make: it is a no-op when the .so is newer than the
+        # sources, and rebuilds a stale library after dataio.cpp edits
+        subprocess.run(
+            ["make", "-s"],
+            cwd=os.path.abspath(_NATIVE_DIR),
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError):
+        pass  # no compiler / read-only tree: a prebuilt .so is still usable
+    return so_path if os.path.exists(so_path) else None
+
+
+def library() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if
+    unavailable."""
+    global _lib, _lib_attempted
+    if _lib is not None or _lib_attempted:
+        return _lib
+    _lib_attempted = True
+    if os.environ.get("AIRCOMP_NO_NATIVE"):
+        return None
+    so_path = _build()
+    if so_path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError:
+        return None
+
+    lib.aircomp_read_idx.restype = ctypes.c_int
+    lib.aircomp_read_idx.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.aircomp_read_cifar_bin.restype = ctypes.c_int
+    lib.aircomp_read_cifar_bin.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.aircomp_normalize_u8.restype = ctypes.c_int
+    lib.aircomp_normalize_u8.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int,
+    ]
+    lib.aircomp_free.restype = None
+    lib.aircomp_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def _take_buffer(lib, ptr, shape, dtype=np.uint8) -> np.ndarray:
+    """Copy a malloc'd native buffer into a NumPy array and free it."""
+    n = int(np.prod(shape))
+    arr = np.ctypeslib.as_array(ptr, shape=(n,)).copy().reshape(shape)
+    lib.aircomp_free(ptr)
+    return arr.astype(dtype, copy=False)
+
+
+def read_idx(path: str) -> Optional[np.ndarray]:
+    """Parse an IDX (optionally .gz) file natively; None on any failure."""
+    lib = library()
+    if lib is None:
+        return None
+    data = ctypes.POINTER(ctypes.c_uint8)()
+    dims = (ctypes.c_int64 * 4)()
+    ndim = ctypes.c_int()
+    rc = lib.aircomp_read_idx(path.encode(), ctypes.byref(data), dims, ctypes.byref(ndim))
+    if rc != 0:
+        return None
+    shape = tuple(int(dims[i]) for i in range(ndim.value))
+    return _take_buffer(lib, data, shape)
+
+
+def read_cifar_bin(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Parse a CIFAR-10 binary batch natively -> (images [N,3,32,32] u8,
+    labels [N] u8); None on any failure."""
+    lib = library()
+    if lib is None:
+        return None
+    img = ctypes.POINTER(ctypes.c_uint8)()
+    lbl = ctypes.POINTER(ctypes.c_uint8)()
+    n = ctypes.c_int64()
+    rc = lib.aircomp_read_cifar_bin(
+        path.encode(), ctypes.byref(img), ctypes.byref(lbl), ctypes.byref(n)
+    )
+    if rc != 0:
+        return None
+    images = _take_buffer(lib, img, (int(n.value), 3, 32, 32))
+    labels = _take_buffer(lib, lbl, (int(n.value),))
+    return images, labels
+
+
+def normalize_u8(x: np.ndarray, mean, std) -> Optional[np.ndarray]:
+    """(x/255 - mean)/std in parallel C++; None if the library is missing.
+
+    Scalar stats normalize every element; sequence stats of length C apply
+    per channel with C the trailing axis (HWC layout).
+    """
+    lib = library()
+    if lib is None:
+        return None
+    means = np.atleast_1d(np.asarray(mean, np.float32))
+    stds = np.atleast_1d(np.asarray(std, np.float32))
+    if means.shape != stds.shape or means.ndim != 1:
+        return None
+    if len(means) > 1 and (x.ndim == 0 or x.shape[-1] != len(means)):
+        return None
+    src = np.ascontiguousarray(x, np.uint8)
+    dst = np.empty(src.shape, np.float32)
+    rc = lib.aircomp_normalize_u8(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        src.size,
+        means.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        stds.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        len(means),
+    )
+    return dst if rc == 0 else None

@@ -1,0 +1,173 @@
+// Native data-ingestion library for byzantine_aircomp_tpu.
+//
+// TPU-native equivalent of the runtime the reference delegates to
+// torchvision's Python loaders (/root/reference/MNIST_Air_weight.py:552-571):
+// parses the raw on-disk formats (IDX for MNIST/EMNIST — optionally
+// gzip-compressed — and CIFAR-10 binary batches) and performs the
+// normalize-to-float32 transform, all in C++ with OpenMP, exposed through a
+// plain C ABI consumed via ctypes (no pybind11 dependency).
+//
+// Error convention: functions return 0 on success, a negative errno-style
+// code otherwise; buffers handed to Python are malloc'd here and released
+// with aircomp_free().
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <zlib.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// memory
+
+void aircomp_free(void* p) { free(p); }
+
+// ---------------------------------------------------------------------------
+// IDX (MNIST/EMNIST) parsing.  Format: big-endian magic [0, 0, dtype, ndim],
+// ndim x uint32 dims, then the payload (uint8 for all files we consume).
+
+static int read_all(const char* path, uint8_t** out, int64_t* out_len) {
+  // gzip-aware read: gzread transparently handles both plain and .gz files
+  gzFile f = gzopen(path, "rb");
+  if (!f) return -1;
+  int64_t cap = 1 << 22, len = 0;
+  uint8_t* buf = (uint8_t*)malloc(cap);
+  if (!buf) {
+    gzclose(f);
+    return -2;
+  }
+  for (;;) {
+    if (len == cap) {
+      cap *= 2;
+      uint8_t* nbuf = (uint8_t*)realloc(buf, cap);
+      if (!nbuf) {
+        free(buf);
+        gzclose(f);
+        return -2;
+      }
+      buf = nbuf;
+    }
+    int n = gzread(f, buf + len, (unsigned)(cap - len));
+    if (n < 0) {
+      free(buf);
+      gzclose(f);
+      return -3;
+    }
+    if (n == 0) break;
+    len += n;
+  }
+  gzclose(f);
+  *out = buf;
+  *out_len = len;
+  return 0;
+}
+
+static uint32_t be32(const uint8_t* p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) | ((uint32_t)p[2] << 8) |
+         (uint32_t)p[3];
+}
+
+// Parses an IDX file.  On success: *data is the malloc'd uint8 payload,
+// dims[0..*ndim-1] the extents (dims must have room for 4).
+int aircomp_read_idx(const char* path, uint8_t** data, int64_t* dims, int* ndim) {
+  uint8_t* raw = nullptr;
+  int64_t len = 0;
+  int rc = read_all(path, &raw, &len);
+  if (rc) return rc;
+  if (len < 4 || raw[0] != 0 || raw[1] != 0) {
+    free(raw);
+    return -4;
+  }
+  int dtype = raw[2], nd = raw[3];
+  if (dtype != 0x08 || nd < 1 || nd > 4 || len < 4 + 4 * nd) {
+    free(raw);
+    return -4;
+  }
+  // dims are untrusted input: reject zero/huge extents and overflow of the
+  // running product before multiplying
+  const int64_t kMaxTotal = (int64_t)1 << 40;
+  int64_t total = 1;
+  for (int i = 0; i < nd; i++) {
+    dims[i] = be32(raw + 4 + 4 * i);
+    if (dims[i] <= 0 || dims[i] > kMaxTotal || total > kMaxTotal / dims[i]) {
+      free(raw);
+      return -4;
+    }
+    total *= dims[i];
+  }
+  if (len < 4 + 4 * nd + total) {
+    free(raw);
+    return -5;
+  }
+  uint8_t* payload = (uint8_t*)malloc(total);
+  if (!payload) {
+    free(raw);
+    return -2;
+  }
+  memcpy(payload, raw + 4 + 4 * nd, total);
+  free(raw);
+  *data = payload;
+  *ndim = nd;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// CIFAR-10 binary batches: n records of [1-byte label | 3072-byte CHW image].
+
+int aircomp_read_cifar_bin(const char* path, uint8_t** images, uint8_t** labels,
+                           int64_t* n_out) {
+  uint8_t* raw = nullptr;
+  int64_t len = 0;
+  int rc = read_all(path, &raw, &len);
+  if (rc) return rc;
+  const int64_t rec = 3073;
+  if (len % rec != 0) {
+    free(raw);
+    return -4;
+  }
+  int64_t n = len / rec;
+  uint8_t* img = (uint8_t*)malloc(n * 3072);
+  uint8_t* lbl = (uint8_t*)malloc(n);
+  if (!img || !lbl) {
+    free(raw);
+    free(img);
+    free(lbl);
+    return -2;
+  }
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; i++) {
+    lbl[i] = raw[i * rec];
+    memcpy(img + i * 3072, raw + i * rec + 1, 3072);
+  }
+  free(raw);
+  *images = img;
+  *labels = lbl;
+  *n_out = n;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Normalization: u8 -> float32 (x/255 - mean)/std, parallel over elements.
+// ``stride`` is the per-channel period for multi-channel stats (HWC layout:
+// stride = channels; single-stat callers pass stride=1 with n_stats=1).
+
+int aircomp_normalize_u8(const uint8_t* src, float* dst, int64_t n,
+                         const float* means, const float* stds, int n_stats) {
+  if (n_stats == 1) {
+    const float mean = means[0], inv = 1.0f / stds[0];
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; i++)
+      dst[i] = ((float)src[i] * (1.0f / 255.0f) - mean) * inv;
+  } else {
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; i++) {
+      int c = (int)(i % n_stats);
+      dst[i] = ((float)src[i] * (1.0f / 255.0f) - means[c]) / stds[c];
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,137 @@
+"""Native C++ data-ingestion library vs the pure-Python fallback.
+
+The native path is optional (AIRCOMP_NO_NATIVE=1 or missing compiler both
+degrade to NumPy); these tests skip when the library cannot be built.
+"""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu.data import native_io
+
+
+def _write_idx(path, arr: np.ndarray):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+@pytest.fixture
+def lib():
+    lib = native_io.library()
+    if lib is None:
+        pytest.skip("native library unavailable")
+    return lib
+
+
+def test_read_idx_roundtrip(lib, tmp_path):
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 256, size=(17, 28, 28), dtype=np.uint8)
+    p = str(tmp_path / "images-idx3-ubyte")
+    _write_idx(p, arr)
+    out = native_io.read_idx(p)
+    assert out is not None and out.shape == arr.shape
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_read_idx_gzip(lib, tmp_path):
+    rng = np.random.default_rng(1)
+    arr = rng.integers(0, 256, size=(9,), dtype=np.uint8)
+    raw = (
+        struct.pack(">HBB", 0, 0x08, 1) + struct.pack(">I", 9) + arr.tobytes()
+    )
+    p = str(tmp_path / "labels-idx1-ubyte.gz")
+    with gzip.open(p, "wb") as f:
+        f.write(raw)
+    out = native_io.read_idx(p)
+    assert out is not None
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_read_idx_corrupt(lib, tmp_path):
+    p = str(tmp_path / "bad")
+    with open(p, "wb") as f:
+        f.write(b"\xff\xff\xff\xff garbage")
+    assert native_io.read_idx(p) is None
+    assert native_io.read_idx(str(tmp_path / "missing")) is None
+
+
+def test_read_idx_overflow_dims(lib, tmp_path):
+    """Dims whose product overflows int64 must fail cleanly, not wrap."""
+    p = str(tmp_path / "overflow")
+    with open(p, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 4))
+        for _ in range(4):
+            f.write(struct.pack(">I", 65536))  # product wraps to 0 in i64
+    assert native_io.read_idx(p) is None
+    p2 = str(tmp_path / "zerodim")
+    with open(p2, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 2))
+        f.write(struct.pack(">I", 0))
+        f.write(struct.pack(">I", 10))
+    assert native_io.read_idx(p2) is None
+
+
+def test_read_cifar_bin(lib, tmp_path):
+    rng = np.random.default_rng(2)
+    n = 11
+    labels = rng.integers(0, 10, size=n, dtype=np.uint8)
+    images = rng.integers(0, 256, size=(n, 3, 32, 32), dtype=np.uint8)
+    p = str(tmp_path / "data_batch_1.bin")
+    with open(p, "wb") as f:
+        for i in range(n):
+            f.write(bytes([labels[i]]))
+            f.write(images[i].tobytes())
+    out = native_io.read_cifar_bin(p)
+    assert out is not None
+    np.testing.assert_array_equal(out[0], images)
+    np.testing.assert_array_equal(out[1], labels)
+
+
+def test_normalize_scalar_matches_numpy(lib):
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, 256, size=(100, 28, 28), dtype=np.uint8)
+    native = native_io.normalize_u8(x, 0.1307, 0.3081)
+    ref = ((x.astype(np.float32) / 255.0) - 0.1307) / 0.3081
+    assert native is not None
+    np.testing.assert_allclose(native, ref, rtol=1e-6)
+
+
+def test_normalize_per_channel_matches_numpy(lib):
+    rng = np.random.default_rng(4)
+    x = rng.integers(0, 256, size=(50, 32, 32, 3), dtype=np.uint8)
+    mean = (0.4914, 0.4822, 0.4465)
+    std = (0.2470, 0.2435, 0.2616)
+    native = native_io.normalize_u8(x, mean, std)
+    ref = ((x.astype(np.float32) / 255.0) - np.asarray(mean, np.float32)) / np.asarray(
+        std, np.float32
+    )
+    assert native is not None
+    # -march=native FMA contraction vs NumPy's strict ordering: ~1e-7 abs
+    np.testing.assert_allclose(native, ref, rtol=2e-4, atol=1e-6)
+
+
+def test_normalize_shape_mismatch_returns_none(lib):
+    x = np.zeros((4, 32, 32, 3), np.uint8)
+    assert native_io.normalize_u8(x, (0.5, 0.5), (0.2, 0.2)) is None
+
+
+def test_datasets_use_native_when_available(tmp_path, monkeypatch):
+    """_read_idx must produce identical bytes through either path."""
+    from byzantine_aircomp_tpu.data import datasets
+
+    rng = np.random.default_rng(5)
+    arr = rng.integers(0, 256, size=(7, 28, 28), dtype=np.uint8)
+    p = str(tmp_path / "f-idx3-ubyte")
+    _write_idx(p, arr)
+    via_framework = datasets._read_idx(p)
+    monkeypatch.setenv("AIRCOMP_NO_NATIVE", "1")
+    monkeypatch.setattr(native_io, "_lib", None)
+    monkeypatch.setattr(native_io, "_lib_attempted", False)
+    via_python = datasets._read_idx(p)
+    np.testing.assert_array_equal(via_framework, via_python)
