@@ -6,8 +6,10 @@ Reference flags (``/root/reference/MNIST_Air_weight.py:16-28``): ``--opt``,
 ``--use-gpu`` is accepted-and-ignored (device selection is JAX's), and
 ``--inherit`` now actually works (resume from checkpoint) instead of being the
 reference's dead flag (``:22,:500``).  New flags: ``--backend {jax,ref}``
-(north-star gate; ``ref`` = NumPy oracle path), ``--dataset``, ``--model``,
-``--rounds``, ``--interval``, ``--batch-size``, ``--gamma``, ``--seed``.
+(north-star gate; ``ref`` = NumPy oracle path), ``--preset`` (BASELINE.json
+configs; flags present on the command line override the preset), ``--dataset``,
+``--model``, ``--rounds``, ``--interval``, ``--batch-size``, ``--gamma``,
+``--seed``, and the execution-layout/observability flags.
 """
 
 from __future__ import annotations
@@ -17,8 +19,40 @@ from typing import Optional, Sequence
 
 from .fed.config import FedConfig
 
+_SHARDING = {"auto": None, "on": True, "off": False}
+
+# single source of truth: argparse dest -> (FedConfig field, converter).
+# Both the kwargs construction and the preset explicit-override scan derive
+# from this, so the two cannot drift.
+ARG_TO_FIELD = {
+    "opt": ("opt", None),
+    "agg": ("agg", None),
+    "attack": ("attack", None),
+    "var": ("noise_var", None),
+    "checkpoint_dir": ("checkpoint_dir", None),
+    "inherit": ("inherit", None),
+    "sharding": ("sharded", _SHARDING.get),
+    "agg_impl": ("agg_impl", None),
+    "profile_dir": ("profile_dir", None),
+    "model_parallel": ("model_parallel", None),
+    "rounds": ("rounds", None),
+    "interval": ("display_interval", None),
+    "batch_size": ("batch_size", None),
+    "gamma": ("gamma", None),
+    "weight_decay": ("weight_decay", None),
+    "seed": ("seed", None),
+    "model": ("model", None),
+    "dataset": ("dataset", None),
+    "mark": ("mark", None),
+    "cache_dir": ("cache_dir", None),
+    "no_eval_train": ("eval_train", lambda v: not v),
+    "eval_train": ("eval_train", None),
+}
+
 
 def build_parser() -> argparse.ArgumentParser:
+    from . import presets
+
     p = argparse.ArgumentParser("byzantine_aircomp_tpu")
     # reference surface
     p.add_argument("--opt", type=str, default="SGD", help="optimizer")
@@ -64,7 +98,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--weight-decay", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=2021)
     p.add_argument("--cache-dir", type=str, default="")
-    p.add_argument("--no-eval-train", action="store_true")
+    eval_group = p.add_mutually_exclusive_group()
+    eval_group.add_argument("--no-eval-train", action="store_true")
+    eval_group.add_argument(
+        "--eval-train",
+        action="store_true",
+        help="force train-set eval on (e.g. over a preset that disables it)",
+    )
     p.add_argument("--checkpoint-dir", type=str, default="")
     p.add_argument(
         "--profile-dir",
@@ -72,45 +112,79 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         help="write a jax.profiler trace of the run here",
     )
+    p.add_argument(
+        "--preset",
+        choices=presets.names(),
+        default=None,
+        help="named BASELINE.json config; flags present on the command line "
+        "override the preset",
+    )
     return p
 
 
-def config_from_args(args) -> FedConfig:
-    cfg = FedConfig(
-        opt=args.opt,
-        agg=args.agg,
-        attack=args.attack,
-        noise_var=args.var,
-        checkpoint_dir=args.checkpoint_dir,
-        inherit=args.inherit,
-        sharded={"auto": None, "on": True, "off": False}[args.sharding],
-        agg_impl=args.agg_impl,
-        profile_dir=args.profile_dir,
-        model_parallel=args.model_parallel,
-        rounds=args.rounds,
-        display_interval=args.interval,
-        batch_size=args.batch_size,
-        gamma=args.gamma,
-        weight_decay=args.weight_decay,
-        seed=args.seed,
-        model=args.model,
-        dataset=args.dataset,
-        mark=args.mark,
-        cache_dir=args.cache_dir,
-        eval_train=not args.no_eval_train,
-    )
-    # reference --K/--B override: honestSize = K - B (:531-533)
+def _explicit_dests(argv: Sequence[str]) -> set:
+    """Dests of the options actually present in ``argv``, detected by
+    re-parsing with every default suppressed (argparse leaves an attribute
+    unset when its default is SUPPRESS and the flag is absent)."""
+    p = build_parser()
+    for action in p._actions:
+        action.default = argparse.SUPPRESS
+    ns, _ = p.parse_known_args(list(argv))
+    return set(vars(ns))
+
+
+def config_from_args(args, argv: Optional[Sequence[str]] = None) -> FedConfig:
+    def field_value(dest):
+        field, conv = ARG_TO_FIELD[dest]
+        v = getattr(args, dest)
+        return field, (conv(v) if conv else v)
+
+    if args.preset is not None:
+        from . import presets
+
+        if argv is None:
+            # explicitness must be derived from the SAME argv that produced
+            # ``args`` — guessing from sys.argv desyncs for programmatic
+            # callers and silently clobbers preset fields
+            raise ValueError(
+                "config_from_args(args, argv) requires the original argv "
+                "when --preset is used"
+            )
+        given = _explicit_dests(argv)
+        overrides = {}
+        for dest in ARG_TO_FIELD:
+            if dest in given:
+                field, value = field_value(dest)
+                overrides[field] = value
+        cfg = presets.get(args.preset, **overrides)
+    else:
+        kwargs = {}
+        for dest in ARG_TO_FIELD:
+            if dest == "eval_train":  # derived from no_eval_train here
+                continue
+            field, value = field_value(dest)
+            kwargs[field] = value
+        cfg = FedConfig(**kwargs)
+    # reference --K/--B override: honestSize = K - B (:531-533); with K alone
+    # the total node count becomes K, retaining the current Byzantine count
     if args.K is not None and args.B is not None:
         cfg.honest_size = args.K - args.B
         cfg.byz_size = args.B
     elif args.K is not None:
-        cfg.honest_size = args.K
+        cfg.honest_size = args.K - cfg.byz_size
+    elif args.B is not None:
+        cfg.honest_size = cfg.node_size - args.B
+        cfg.byz_size = args.B
     return cfg
 
 
 def main(argv: Optional[Sequence[str]] = None):
+    import sys
+
+    if argv is None:
+        argv = sys.argv[1:]
     args = build_parser().parse_args(argv)
-    cfg = config_from_args(args)
+    cfg = config_from_args(args, argv)
     if args.backend == "ref":
         from .backends.ref_trainer import run_ref
 

@@ -82,7 +82,7 @@ class FedConfig:
         assert self.byz_size == 0 or self.attack is not None, (
             "byz_size > 0 requires an attack"
         )
-        assert self.honest_size != 0, "honest_size must be nonzero"
+        assert self.honest_size > 0, "honest_size must be positive"
         assert self.agg_impl in ("xla", "pallas"), (
             f"agg_impl must be 'xla' or 'pallas', got {self.agg_impl!r}"
         )

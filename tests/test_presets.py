@@ -1,0 +1,106 @@
+"""Every BASELINE.json preset must build and run end-to-end (tiny scale)."""
+
+import jax.numpy as jnp
+import pytest
+
+from byzantine_aircomp_tpu import presets
+from byzantine_aircomp_tpu.cli import build_parser, config_from_args
+from byzantine_aircomp_tpu.data import datasets as data_lib
+from byzantine_aircomp_tpu.fed.train import FedTrainer
+
+
+def test_names_cover_baseline_ladder():
+    names = presets.names()
+    assert "mnist_mlp_k50_baseline" in names
+    assert "emnist_cnn_k200_b40_classflip" in names
+    assert "cifar10_resnet18_k1000_b100_signflip_krum" in names
+    assert len(names) >= 5
+
+
+def test_get_unknown_raises():
+    with pytest.raises(KeyError):
+        presets.get("nope")
+
+
+def test_overrides_win():
+    cfg = presets.get("mnist_mlp_k50_b5_classflip", rounds=3, agg="median")
+    assert cfg.rounds == 3 and cfg.agg == "median"
+    assert cfg.attack == "classflip"  # preset value survives
+
+
+@pytest.mark.parametrize("name", presets.names())
+def test_preset_runs_one_round_tiny(name):
+    """Shrink topology/schedule, keep model/attack/agg/channel semantics."""
+    has_attack = presets.PRESETS[name].get("attack") is not None
+    cfg = presets.get(
+        name,
+        honest_size=3,
+        byz_size=1 if has_attack else 0,
+        rounds=1,
+        display_interval=1,
+        batch_size=4,
+        eval_batch=16,
+        agg_maxiter=5,
+        eval_train=False,
+    )
+    ds = data_lib.load(cfg.dataset, synthetic_train=64, synthetic_val=32)
+    tr = FedTrainer(cfg, dataset=ds)
+    tr.run_round(0)
+    assert jnp.isfinite(tr.flat_params).all()
+    loss, acc = tr.evaluate("val")
+    assert jnp.isfinite(loss) and 0.0 <= acc <= 1.0
+
+
+def test_cli_preset_with_overrides():
+    argv = ["--preset", "mnist_mlp_k50_b10_classflip_air", "--rounds", "2"]
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args, argv)
+    assert cfg.rounds == 2  # explicit flag wins
+    assert cfg.agg == "gm" and cfg.noise_var == 1e-2  # preset preserved
+    assert cfg.byz_size == 10 and cfg.honest_size == 40
+
+
+def test_cli_preset_K_B_override():
+    argv = ["--preset", "mnist_mlp_k50_baseline", "--K", "20", "--B", "4"]
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args, argv)
+    assert cfg.honest_size == 16 and cfg.byz_size == 4
+
+
+def test_cli_preset_explicit_flag_at_default_value_wins():
+    """A flag given explicitly still overrides the preset even when its value
+    equals the parser default (presence detection, not value comparison)."""
+    argv = [
+        "--preset",
+        "cifar10_resnet18_k1000_b100_signflip_krum",
+        "--agg",
+        "gm",
+        "--dataset",
+        "mnist",
+    ]
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args, argv)
+    assert cfg.agg == "gm"  # parser default value, but explicitly requested
+    assert cfg.dataset == "mnist"
+    assert cfg.model == "ResNet18"  # untouched preset field survives
+
+
+def test_cli_preset_eval_train_reenable():
+    argv = ["--preset", "emnist_cnn_k200_b40_classflip", "--eval-train"]
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args, argv)
+    assert cfg.eval_train is True
+
+
+def test_cli_preset_K_alone_keeps_total():
+    """--K sets the TOTAL node count; the preset's Byzantine count is kept."""
+    argv = ["--preset", "cifar10_resnet18_k1000_b100_signflip_krum", "--K", "200"]
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args, argv)
+    assert cfg.node_size == 200 and cfg.byz_size == 100
+
+
+def test_cli_unknown_preset_is_clean_error(capsys):
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--preset", "not_a_preset"])
+    assert "invalid choice" in capsys.readouterr().err
