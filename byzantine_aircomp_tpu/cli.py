@@ -119,6 +119,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="named BASELINE.json config; flags present on the command line "
         "override the preset",
     )
+    # multi-host launch, one process per host.  --multihost alone relies on
+    # cluster env auto-detection (TPU pods, GKE, Slurm); manual launches add
+    # coordinator/num-processes/process-id.  Any of the four triggers
+    # jax.distributed.initialize.
+    p.add_argument(
+        "--multihost",
+        action="store_true",
+        help="initialize jax.distributed (auto-detects the cluster env when "
+        "the explicit flags are omitted)",
+    )
+    p.add_argument("--coordinator", type=str, default=None, help="host:port")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     return p
 
 
@@ -184,6 +197,20 @@ def main(argv: Optional[Sequence[str]] = None):
     if argv is None:
         argv = sys.argv[1:]
     args = build_parser().parse_args(argv)
+    if (
+        args.multihost
+        or args.coordinator is not None
+        or args.num_processes is not None
+        or args.process_id is not None
+    ):
+        from .parallel import multihost
+
+        multihost.initialize(
+            coordinator=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+        print(multihost.process_summary())
     cfg = config_from_args(args, argv)
     if args.backend == "ref":
         from .backends.ref_trainer import run_ref

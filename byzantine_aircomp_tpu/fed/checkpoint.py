@@ -6,9 +6,9 @@ only end-of-run *metrics* are pickled — model weights are discarded
 (``:472``).  This framework makes resume real: the flat parameter vector plus
 round index are written every round, and ``--inherit`` restores them.
 
-Format: a plain ``.npz`` per run title (atomic-rename write).  The
-orbax-based multi-host checkpointer in ``utils.checkpoint`` builds on the
-same layout for sharded params.
+Format: a plain ``.npz`` per run title (atomic-rename write) — the fast
+single-host path.  ``utils.checkpoint`` provides the orbax-based variant for
+structured params pytrees and multi-host sharded saves.
 """
 
 from __future__ import annotations

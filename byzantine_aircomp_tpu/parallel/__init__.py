@@ -1,2 +1,2 @@
-from . import collective, mesh  # noqa: F401
+from . import collective, mesh, multihost  # noqa: F401
 from .sharded import ShardedFedTrainer  # noqa: F401

@@ -1,0 +1,76 @@
+"""Multi-host (multi-process) execution over DCN.
+
+The reference is strictly single-process — there is no NCCL/MPI/process-group
+code anywhere (SURVEY.md §2 preamble; ``torch.distributed`` is never
+imported).  This framework's distributed communication backend is JAX's:
+``jax.distributed`` brings up the cross-host runtime, every process
+contributes its local chips, and the same SPMD round program the single-host
+path jits is laid out over the GLOBAL device mesh — XLA routes the
+aggregation collectives over ICI within a slice and DCN across hosts.
+``ShardedFedTrainer`` needs no changes: both processes trace the identical
+program against the global mesh and each executes its addressable shard
+(validated by the two-process CPU test in test_multihost.py).
+
+Mesh layout guidance: keep the ``model`` axis within a host/slice (ICI) and
+let the ``clients`` axis span hosts — client shards only meet at the
+aggregation psum, one [d]-sized reduction per round, which is the only
+traffic that rides DCN.
+
+Usage (one call per process, before any other JAX API touches devices)::
+
+    from byzantine_aircomp_tpu.parallel import multihost
+    multihost.initialize(coordinator="host0:8476", num_processes=4,
+                         process_id=rank)
+
+or rely on the standard cluster env detection (TPU pods, GKE) by calling
+``initialize()`` with no arguments.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[list] = None,
+) -> None:
+    """Bring up the cross-host runtime (idempotent).
+
+    With no arguments JAX auto-detects cluster environments (TPU pods, GKE,
+    Slurm); explicit values cover manual launches.  After this returns,
+    ``jax.devices()`` is the GLOBAL device list and meshes built from it span
+    all hosts.
+    """
+    global _initialized
+    if _initialized:
+        return
+    kwargs = {}
+    if coordinator is not None:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+
+
+def is_distributed() -> bool:
+    return jax.process_count() > 1
+
+
+def process_summary() -> str:
+    return (
+        f"process {jax.process_index()}/{jax.process_count()}: "
+        f"{len(jax.local_devices())} local of {len(jax.devices())} global "
+        f"devices ({jax.default_backend()})"
+    )

@@ -1,0 +1,76 @@
+"""Orbax checkpointing for params pytrees (multi-host aware).
+
+Complements the flat ``.npz`` fast path in ``fed.checkpoint`` (which stores
+the [d] vector + round index): this writes the STRUCTURED params pytree via
+orbax, which handles atomic commits and, on multi-host meshes, coordinates
+the distributed save so each process writes only its addressable shards.
+
+The reference has no checkpointing at all — its ``--inherit`` flag is dead
+(``/root/reference/MNIST_Air_weight.py:22,:500``) and final weights are
+discarded (``:472``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+
+
+_CKPTR = None
+
+
+def _checkpointer():
+    global _CKPTR
+    if _CKPTR is None:
+        import orbax.checkpoint as ocp
+
+        _CKPTR = ocp.StandardCheckpointer()
+    return _CKPTR
+
+
+def step_dir(ckpt_dir: str, title: str, round_idx: int) -> str:
+    return os.path.join(os.path.abspath(ckpt_dir), title, f"round_{round_idx:06d}")
+
+
+def save(ckpt_dir: str, title: str, round_idx: int, params: Any) -> str:
+    """Write the params pytree for ``round_idx``; returns the step dir."""
+    path = step_dir(ckpt_dir, title, round_idx)
+    ckptr = _checkpointer()
+    ckptr.save(path, params, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def latest_round(ckpt_dir: str, title: str) -> Optional[int]:
+    root = os.path.join(os.path.abspath(ckpt_dir), title)
+    if not os.path.isdir(root):
+        return None
+    rounds = [
+        int(name.split("_")[1])
+        for name in os.listdir(root)
+        if name.startswith("round_") and name.split("_")[1].isdigit()
+    ]
+    return max(rounds) if rounds else None
+
+
+def load(
+    ckpt_dir: str, title: str, example_params: Any, round_idx: Optional[int] = None
+) -> Optional[Tuple[int, Any]]:
+    """Restore (round_idx, params). ``example_params`` supplies the target
+    structure/shardings (pass the freshly-initialized pytree — on a mesh, one
+    whose leaves carry the desired shardings)."""
+    if round_idx is None:
+        round_idx = latest_round(ckpt_dir, title)
+        if round_idx is None:
+            return None
+    path = step_dir(ckpt_dir, title, round_idx)
+    if not os.path.isdir(path):
+        return None
+    ref = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
+        example_params,
+    )
+    params = _checkpointer().restore(path, ref)
+    return round_idx, params

@@ -23,3 +23,9 @@ jax.config.update("jax_enable_x64", False)
 
 assert jax.default_backend() == "cpu"
 assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for mesh tests"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration tests (multi-process, presets)"
+    )

@@ -1,0 +1,51 @@
+"""Orbax pytree checkpointing (utils.checkpoint)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu.utils import checkpoint as ockpt
+
+
+@pytest.fixture
+def params():
+    return {
+        "dense": {
+            "kernel": jnp.arange(12.0).reshape(3, 4),
+            "bias": jnp.ones((4,), jnp.float32),
+        }
+    }
+
+
+def test_save_load_roundtrip(tmp_path, params):
+    path = ockpt.save(str(tmp_path), "run_a", 7, params)
+    assert "round_000007" in path
+    out = ockpt.load(str(tmp_path), "run_a", params)
+    assert out is not None
+    round_idx, restored = out
+    assert round_idx == 7
+    jax.tree.map(np.testing.assert_array_equal, restored, params)
+
+
+def test_latest_round_selection(tmp_path, params):
+    ockpt.save(str(tmp_path), "run_b", 1, params)
+    bumped = jax.tree.map(lambda x: x + 1.0, params)
+    ockpt.save(str(tmp_path), "run_b", 3, bumped)
+    assert ockpt.latest_round(str(tmp_path), "run_b") == 3
+    round_idx, restored = ockpt.load(str(tmp_path), "run_b", params)
+    assert round_idx == 3
+    jax.tree.map(np.testing.assert_array_equal, restored, bumped)
+
+
+def test_load_missing_returns_none(tmp_path, params):
+    assert ockpt.load(str(tmp_path), "nope", params) is None
+    assert ockpt.latest_round(str(tmp_path), "nope") is None
+
+
+def test_explicit_round(tmp_path, params):
+    ockpt.save(str(tmp_path), "run_c", 2, params)
+    ockpt.save(str(tmp_path), "run_c", 5, params)
+    out = ockpt.load(str(tmp_path), "run_c", params, round_idx=2)
+    assert out is not None and out[0] == 2
+    assert ockpt.load(str(tmp_path), "run_c", params, round_idx=9) is None
