@@ -47,6 +47,10 @@ ARG_TO_FIELD = {
     "cache_dir": ("cache_dir", None),
     "no_eval_train": ("eval_train", lambda v: not v),
     "eval_train": ("eval_train", None),
+    "local_steps": ("local_steps", None),
+    "server_opt": ("server_opt", None),
+    "server_lr": ("server_lr", None),
+    "server_momentum": ("server_momentum", None),
 }
 
 
@@ -96,6 +100,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, default=50)
     p.add_argument("--gamma", type=float, default=1e-2)
     p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument(
+        "--local-steps",
+        type=int,
+        default=1,
+        help="local SGD steps per client per iteration (1 = reference FedSGD)",
+    )
+    p.add_argument(
+        "--server-opt",
+        choices=["none", "momentum", "adam"],
+        default="none",
+        help="server optimizer over the pseudo-gradient (FedAvgM / FedAdam)",
+    )
+    p.add_argument("--server-lr", type=float, default=1.0)
+    p.add_argument("--server-momentum", type=float, default=0.9)
     p.add_argument("--seed", type=int, default=2021)
     p.add_argument("--cache-dir", type=str, default="")
     eval_group = p.add_mutually_exclusive_group()

@@ -24,13 +24,21 @@ def checkpoint_file(ckpt_dir: str, title: str) -> str:
     return os.path.join(ckpt_dir, title + ".ckpt.npz")
 
 
-def save(ckpt_dir: str, title: str, round_idx: int, flat_params) -> str:
+def save(
+    ckpt_dir: str, title: str, round_idx: int, flat_params, opt_leaves=()
+) -> str:
+    """Write params (+ optional server-optimizer state leaves, in pytree-leaf
+    order) atomically."""
     os.makedirs(ckpt_dir, exist_ok=True)
     path = checkpoint_file(ckpt_dir, title)
+    # materialize host copies BEFORE acquiring the fd: a device error here
+    # must not leak the tmp file
+    flat_host = np.asarray(flat_params)
+    extras = {f"opt_{i}": np.asarray(leaf) for i, leaf in enumerate(opt_leaves)}
     fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            np.savez(f, round_idx=round_idx, flat_params=np.asarray(flat_params))
+            np.savez(f, round_idx=round_idx, flat_params=flat_host, **extras)
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -39,9 +47,14 @@ def save(ckpt_dir: str, title: str, round_idx: int, flat_params) -> str:
     return path
 
 
-def load(ckpt_dir: str, title: str) -> Optional[Tuple[int, np.ndarray]]:
+def load(
+    ckpt_dir: str, title: str
+) -> Optional[Tuple[int, np.ndarray, list]]:
+    """Returns (round_idx, flat_params, opt_leaves) or None."""
     path = checkpoint_file(ckpt_dir, title)
     if not os.path.exists(path):
         return None
     with np.load(path) as z:
-        return int(z["round_idx"]), z["flat_params"]
+        n_opt = sum(1 for k in z.files if k.startswith("opt_"))
+        opt_leaves = [z[f"opt_{i}"] for i in range(n_opt)]
+        return int(z["round_idx"]), z["flat_params"], opt_leaves

@@ -26,6 +26,16 @@ class FedConfig:
     gamma: float = 1e-2
     weight_decay: float = 0.0
     batch_size: int = 50
+    # local SGD steps per client per global iteration.  1 = the reference's
+    # FedSGD (MNIST_Air_weight.py:296-303); >1 = the FedAvg regime, each
+    # step on a fresh with-replacement batch
+    local_steps: int = 1
+    # server-side optimizer applied to the pseudo-gradient
+    # (global_params - aggregated): "none" = take the aggregate directly
+    # (reference semantics, :354-358); "momentum" = FedAvgM; "adam" = FedAdam
+    server_opt: str = "none"
+    server_lr: float = 1.0
+    server_momentum: float = 0.9
 
     # dispatch
     agg: str = "gm"
@@ -85,5 +95,9 @@ class FedConfig:
         assert self.honest_size > 0, "honest_size must be positive"
         assert self.agg_impl in ("xla", "pallas"), (
             f"agg_impl must be 'xla' or 'pallas', got {self.agg_impl!r}"
+        )
+        assert self.local_steps >= 1, "local_steps must be >= 1"
+        assert self.server_opt in ("none", "momentum", "adam"), (
+            f"server_opt must be none|momentum|adam, got {self.server_opt!r}"
         )
         return self

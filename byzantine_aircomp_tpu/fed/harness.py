@@ -38,6 +38,13 @@ def run_title(cfg: FedConfig) -> str:
     title = f"{cfg.model}_{cfg.opt}_{attack_name}_{cfg.agg}"
     if cfg.noise_var is not None:
         title += f"_{cfg.noise_var}"
+    # framework extensions beyond the reference scheme (:446-455) append
+    # only when non-default, so reference-equivalent runs keep identical
+    # titles AND differently-configured runs never collide on checkpoints
+    if cfg.local_steps != 1:
+        title += f"_E{cfg.local_steps}"
+    if cfg.server_opt != "none":
+        title += f"_{cfg.server_opt}{cfg.server_lr}"
     if cfg.mark:
         title += f"_{cfg.mark}"
     return title
@@ -134,14 +141,35 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
     checkpoint_fn = None
     title = run_title(cfg)
     if cfg.checkpoint_dir:
+        import jax
+
         checkpoint_fn = lambda r, t: checkpoint.save(
-            cfg.checkpoint_dir, title, r, t.flat_params
+            cfg.checkpoint_dir,
+            title,
+            r,
+            t.flat_params,
+            # custom OPTIMIZERS-registered trainers may have no server opt
+            jax.tree.leaves(getattr(t, "server_opt_state", ())),
         )
         if cfg.inherit:
             restored = checkpoint.load(cfg.checkpoint_dir, title)
             if restored is not None:
-                start_round, flat = restored
+                start_round, flat, opt_leaves = restored
                 trainer.flat_params = jnp.asarray(flat)
+                own_state = getattr(trainer, "server_opt_state", ())
+                own_leaves = jax.tree.leaves(own_state)
+                if len(opt_leaves) == len(own_leaves) and opt_leaves:
+                    trainer.server_opt_state = jax.tree.unflatten(
+                        jax.tree.structure(own_state),
+                        [jnp.asarray(l) for l in opt_leaves],
+                    )
+                elif len(opt_leaves) != len(own_leaves):
+                    log(
+                        "WARNING: checkpoint server-opt state "
+                        f"({len(opt_leaves)} leaves) does not match this "
+                        f"config ({len(own_leaves)}); starting the server "
+                        "optimizer fresh"
+                    )
                 log(f"Resumed from checkpoint at round {start_round}")
 
     log("Optimization begin")

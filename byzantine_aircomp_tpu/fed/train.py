@@ -127,7 +127,19 @@ class FedTrainer:
         # the round fn is first traced (GSPMD cannot partition pallas_call)
         self._agg_impl = cfg.agg_impl
 
-        self._round_fn = jax.jit(self._build_round_fn(), donate_argnums=(0,))
+        # server optimizer over the pseudo-gradient (FedAvgM / FedAdam);
+        # "none" = take the aggregate directly (reference :354-358)
+        if cfg.server_opt == "momentum":
+            self._server_tx = optax.sgd(cfg.server_lr, momentum=cfg.server_momentum)
+        elif cfg.server_opt == "adam":
+            self._server_tx = optax.adam(cfg.server_lr)
+        else:
+            self._server_tx = None
+        self.server_opt_state = (
+            self._server_tx.init(self.flat_params) if self._server_tx else ()
+        )
+
+        self._round_fn = jax.jit(self._build_round_fn(), donate_argnums=(0, 1))
         self._eval_fn = jax.jit(self._build_eval_fn())
         self._eval_cache: Dict[str, Any] = {}
 
@@ -159,32 +171,48 @@ class FedTrainer:
 
         return jax.grad(loss)(flat_params)
 
-    def _iteration(self, flat_params, key):
+    def _per_client_weights(self, flat_params, x_k, y_k, is_byz):
+        """Client weights after ``local_steps`` local SGD steps (FedAvg
+        regime), each on its own batch: x_k [E, B, ...], y_k [E, B].
+        Generalizes the reference's single step; gradient-scale attacks apply
+        at every local step."""
+        cfg = self.cfg
+        gscale = 1.0
+        if self.attack is not None and self.attack.grad_scale != 1.0:
+            gscale = jnp.where(is_byz, self.attack.grad_scale, 1.0)
+
+        def step(w, xy):
+            x_e, y_e = xy
+            g = self._per_client_grad(w, x_e, y_e, is_byz) * gscale
+            return w - cfg.gamma * (g + cfg.weight_decay * w), None
+
+        w_final, _ = jax.lax.scan(step, flat_params, (x_k, y_k))
+        return w_final
+
+    def _iteration(self, carry, key):
         """One global iteration: local steps -> attack -> channel -> agg."""
         cfg = self.cfg
+        flat_params, opt_state = carry
         k_batch, k_chan, k_agg, k_msg = jax.random.split(key, 4)
 
         with jax.named_scope("client_local_step"):
+            # E local steps per client, each on a fresh with-replacement
+            # batch.  E=1 is the reference's FedSGD (:296-303): the length-1
+            # scan in _per_client_weights computes exactly
+            # w <- fp - gamma*(g*scale + wd*fp), and the [K, E*B] index
+            # stream equals the single-step stream (same key, same count)
             idx = data_lib.sample_client_batch_indices(
-                k_batch, self.offsets, self.sizes, cfg.batch_size
+                k_batch, self.offsets, self.sizes,
+                cfg.local_steps * cfg.batch_size,
             )
-            x = self.x_train[idx]  # [K, B, features] on-device 2D gather
-            if self._spatial_input:
-                x = x.reshape(idx.shape + self._sample_shape)
-            y = self.y_train[idx]
-
-            grads = jax.vmap(self._per_client_grad, in_axes=(None, 0, 0, 0))(
+            x = self.x_train[idx]  # [K, E*B, features] on-device 2D gather
+            shape = (cfg.node_size, cfg.local_steps, cfg.batch_size)
+            x = x.reshape(
+                shape + (self._sample_shape if self._spatial_input else (-1,))
+            )
+            y = self.y_train[idx].reshape(shape)
+            w_stack = jax.vmap(self._per_client_weights, in_axes=(None, 0, 0, 0))(
                 flat_params, x, y, self.byz_mask
-            )  # [K, d]
-            grads = self._constrain_stack(grads)
-
-            if self.attack is not None and self.attack.grad_scale != 1.0:
-                scale = jnp.where(self.byz_mask, self.attack.grad_scale, 1.0)
-                grads = grads * scale[:, None]
-
-            # one local SGD step from the shared global params (:302-303)
-            w_stack = flat_params[None, :] - cfg.gamma * (
-                grads + cfg.weight_decay * flat_params[None, :]
             )
             w_stack = self._constrain_stack(w_stack)
 
@@ -197,7 +225,7 @@ class FedTrainer:
                 w_stack = channel_lib.oma(k_chan, w_stack, cfg.noise_var)
 
         with jax.named_scope("aggregate"):
-            new_flat = self.agg_fn(
+            aggregated = self.agg_fn(
                 w_stack,
                 honest_size=cfg.honest_size,
                 key=k_agg,
@@ -208,15 +236,26 @@ class FedTrainer:
                 p_max=cfg.gm_p_max,
                 impl=self._agg_impl,
             )
+            if self._server_tx is not None:
+                # FedOpt: the aggregate defines a pseudo-gradient
+                delta = flat_params - aggregated
+                updates, opt_state = self._server_tx.update(
+                    delta, opt_state, flat_params
+                )
+                new_flat = optax.apply_updates(flat_params, updates)
+            else:
+                new_flat = aggregated  # reference semantics (:354-358)
             new_flat = self._constrain_params(new_flat)
         variance = honest_variance(w_stack, cfg.honest_size)
-        return new_flat, variance
+        return (new_flat, opt_state), variance
 
     def _build_round_fn(self):
-        def round_fn(flat_params, round_key):
+        def round_fn(flat_params, opt_state, round_key):
             keys = jax.random.split(round_key, self.cfg.display_interval)
-            final, variances = jax.lax.scan(self._iteration, flat_params, keys)
-            return final, variances[-1]
+            (final, opt_final), variances = jax.lax.scan(
+                self._iteration, (flat_params, opt_state), keys
+            )
+            return final, opt_final, variances[-1]
 
         return round_fn
 
@@ -277,7 +316,9 @@ class FedTrainer:
         (~3x the round's compute on a tunneled chip); callers convert when
         they actually consume the value."""
         round_key = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), round_idx)
-        self.flat_params, variance = self._round_fn(self.flat_params, round_key)
+        self.flat_params, self.server_opt_state, variance = self._round_fn(
+            self.flat_params, self.server_opt_state, round_key
+        )
         return variance
 
     def train(

@@ -58,6 +58,14 @@ class ShardedFedTrainer(FedTrainer):
         self.x_train = jax.device_put(self.x_train, repl)
         self.y_train = jax.device_put(self.y_train, repl)
         self.flat_params = jax.device_put(self.flat_params, p_shard)
+        # server-opt state: [d]-shaped leaves follow the params layout,
+        # scalars (e.g. adam's count) replicate
+        self.server_opt_state = jax.tree.map(
+            lambda leaf: jax.device_put(
+                leaf, p_shard if getattr(leaf, "ndim", 0) == 1 else repl
+            ),
+            self.server_opt_state,
+        )
 
     def _constrain_stack(self, w_stack):
         return jax.lax.with_sharding_constraint(

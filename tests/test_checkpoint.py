@@ -22,7 +22,7 @@ def _cfg(rounds):
 def test_save_load_round_trip(tmp_path):
     flat = np.arange(10.0, dtype=np.float32)
     checkpoint.save(str(tmp_path), "t", 7, flat)
-    r, loaded = checkpoint.load(str(tmp_path), "t")
+    r, loaded, _ = checkpoint.load(str(tmp_path), "t")
     assert r == 7
     np.testing.assert_array_equal(loaded, flat)
     assert checkpoint.load(str(tmp_path), "missing") is None
@@ -42,7 +42,7 @@ def test_resume_matches_uninterrupted(tmp_path):
         t_a.run_round(r)
     checkpoint.save(str(tmp_path), "t", 2, t_a.flat_params)
 
-    r0, flat = checkpoint.load(str(tmp_path), "t")
+    r0, flat, _ = checkpoint.load(str(tmp_path), "t")
     t_b = FedTrainer(_cfg(4), dataset=ds)
     t_b.flat_params = np.asarray(flat)
     for r in range(r0, 4):
