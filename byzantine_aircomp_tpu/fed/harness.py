@@ -43,7 +43,9 @@ def run_title(cfg: FedConfig) -> str:
     # titles AND differently-configured runs never collide on checkpoints
     if cfg.local_steps != 1:
         title += f"_E{cfg.local_steps}"
-    if cfg.server_opt != "none":
+    if cfg.server_opt == "momentum":
+        title += f"_momentum{cfg.server_lr}m{cfg.server_momentum}"
+    elif cfg.server_opt != "none":
         title += f"_{cfg.server_opt}{cfg.server_lr}"
     if cfg.mark:
         title += f"_{cfg.mark}"
@@ -155,13 +157,20 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
             restored = checkpoint.load(cfg.checkpoint_dir, title)
             if restored is not None:
                 start_round, flat, opt_leaves = restored
-                trainer.flat_params = jnp.asarray(flat)
+                # restore through the trainer's existing layouts — a plain
+                # asarray would drop the mesh sharding on sharded runs
+                trainer.flat_params = jax.device_put(
+                    flat, trainer.flat_params.sharding
+                )
                 own_state = getattr(trainer, "server_opt_state", ())
                 own_leaves = jax.tree.leaves(own_state)
                 if len(opt_leaves) == len(own_leaves) and opt_leaves:
                     trainer.server_opt_state = jax.tree.unflatten(
                         jax.tree.structure(own_state),
-                        [jnp.asarray(l) for l in opt_leaves],
+                        [
+                            jax.device_put(l, own.sharding)
+                            for l, own in zip(opt_leaves, own_leaves)
+                        ],
                     )
                 elif len(opt_leaves) != len(own_leaves):
                     log(

@@ -189,8 +189,13 @@ class FedTrainer:
         w_final, _ = jax.lax.scan(step, flat_params, (x_k, y_k))
         return w_final
 
-    def _iteration(self, carry, key):
-        """One global iteration: local steps -> attack -> channel -> agg."""
+    def _iteration(self, carry, key, x_train, y_train):
+        """One global iteration: local steps -> attack -> channel -> agg.
+
+        The train arrays arrive as explicit ARGUMENTS (threaded through the
+        jitted round fn) rather than closure captures: captured arrays embed
+        into the serialized computation, which breaks remote-compile setups
+        at dataset scale and bloats every compile."""
         cfg = self.cfg
         flat_params, opt_state = carry
         k_batch, k_chan, k_agg, k_msg = jax.random.split(key, 4)
@@ -205,12 +210,12 @@ class FedTrainer:
                 k_batch, self.offsets, self.sizes,
                 cfg.local_steps * cfg.batch_size,
             )
-            x = self.x_train[idx]  # [K, E*B, features] on-device 2D gather
+            x = x_train[idx]  # [K, E*B, features] on-device 2D gather
             shape = (cfg.node_size, cfg.local_steps, cfg.batch_size)
             x = x.reshape(
                 shape + (self._sample_shape if self._spatial_input else (-1,))
             )
-            y = self.y_train[idx].reshape(shape)
+            y = y_train[idx].reshape(shape)
             w_stack = jax.vmap(self._per_client_weights, in_axes=(None, 0, 0, 0))(
                 flat_params, x, y, self.byz_mask
             )
@@ -250,10 +255,14 @@ class FedTrainer:
         return (new_flat, opt_state), variance
 
     def _build_round_fn(self):
-        def round_fn(flat_params, opt_state, round_key):
+        def round_fn(flat_params, opt_state, round_key, x_train, y_train):
             keys = jax.random.split(round_key, self.cfg.display_interval)
+
+            def it(carry, key):
+                return self._iteration(carry, key, x_train, y_train)
+
             (final, opt_final), variances = jax.lax.scan(
-                self._iteration, (flat_params, opt_state), keys
+                it, (flat_params, opt_state), keys
             )
             return final, opt_final, variances[-1]
 
@@ -317,7 +326,8 @@ class FedTrainer:
         they actually consume the value."""
         round_key = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), round_idx)
         self.flat_params, self.server_opt_state, variance = self._round_fn(
-            self.flat_params, self.server_opt_state, round_key
+            self.flat_params, self.server_opt_state, round_key,
+            self.x_train, self.y_train,
         )
         return variance
 
