@@ -140,6 +140,9 @@ class FedTrainer:
         )
 
         self._round_fn = jax.jit(self._build_round_fn(), donate_argnums=(0, 1))
+        self._multi_round_fn = jax.jit(
+            self._build_multi_round_fn(), donate_argnums=(0, 1)
+        )
         self._eval_fn = jax.jit(self._build_eval_fn())
         self._eval_cache: Dict[str, Any] = {}
 
@@ -189,13 +192,19 @@ class FedTrainer:
         w_final, _ = jax.lax.scan(step, flat_params, (x_k, y_k))
         return w_final
 
-    def _iteration(self, carry, key, x_train, y_train):
+    def _iteration(self, carry, key, x_train, y_train, want_variance):
         """One global iteration: local steps -> attack -> channel -> agg.
 
         The train arrays arrive as explicit ARGUMENTS (threaded through the
         jitted round fn) rather than closure captures: captured arrays embed
         into the serialized computation, which breaks remote-compile setups
-        at dataset scale and bloats every compile."""
+        at dataset scale and bloats every compile.
+
+        ``want_variance`` (traced bool) gates the honest-dispersion metric
+        behind a ``lax.cond``: the reference computes ``getVarience`` ONCE per
+        round on the last iteration's stack (``:360-361``), so the other
+        ``display_interval - 1`` iterations skip the extra [honest, d]
+        passes entirely."""
         cfg = self.cfg
         flat_params, opt_state = carry
         k_batch, k_chan, k_agg, k_msg = jax.random.split(key, 4)
@@ -251,22 +260,55 @@ class FedTrainer:
             else:
                 new_flat = aggregated  # reference semantics (:354-358)
             new_flat = self._constrain_params(new_flat)
-        variance = honest_variance(w_stack, cfg.honest_size)
+        variance = jax.lax.cond(
+            want_variance,
+            lambda w: honest_variance(w, cfg.honest_size),
+            lambda w: jnp.float32(0.0),
+            w_stack,
+        )
         return (new_flat, opt_state), variance
 
-    def _build_round_fn(self):
-        def round_fn(flat_params, opt_state, round_key, x_train, y_train):
-            keys = jax.random.split(round_key, self.cfg.display_interval)
+    def _round_core(self, flat_params, opt_state, round_key, x_train, y_train):
+        """One round (display_interval scanned iterations) as a pure fn."""
+        interval = self.cfg.display_interval
+        keys = jax.random.split(round_key, interval)
+        want = jnp.arange(interval) == interval - 1
 
-            def it(carry, key):
-                return self._iteration(carry, key, x_train, y_train)
+        def it(carry, kf):
+            key, want_var = kf
+            return self._iteration(carry, key, x_train, y_train, want_var)
+
+        (final, opt_final), variances = jax.lax.scan(
+            it, (flat_params, opt_state), (keys, want)
+        )
+        return final, opt_final, variances[-1]
+
+    def _build_round_fn(self):
+        return self._round_core
+
+    def _build_multi_round_fn(self):
+        """n rounds in ONE device program: an outer scan over round indices.
+
+        Per-round keys are the same ``fold_in(PRNGKey(seed), round)``
+        derivation as :meth:`run_round`, so ``run_rounds(r0, n)`` is
+        bit-identical to n successive ``run_round`` calls — it only removes
+        the per-round host dispatch (a few ms each on a tunneled chip)."""
+        base_key = jax.random.PRNGKey(self.cfg.seed)
+
+        def multi_fn(flat_params, opt_state, rounds, x_train, y_train):
+            def body(carry, r):
+                fp, os = carry
+                fp, os, var = self._round_core(
+                    fp, os, jax.random.fold_in(base_key, r), x_train, y_train
+                )
+                return (fp, os), var
 
             (final, opt_final), variances = jax.lax.scan(
-                it, (flat_params, opt_state), keys
+                body, (flat_params, opt_state), rounds
             )
-            return final, opt_final, variances[-1]
+            return final, opt_final, variances
 
-        return round_fn
+        return multi_fn
 
     def _build_eval_fn(self):
         eval_b = self.cfg.eval_batch
@@ -330,6 +372,19 @@ class FedTrainer:
             self.x_train, self.y_train,
         )
         return variance
+
+    def run_rounds(self, start_round: int, num_rounds: int) -> jax.Array:
+        """Execute ``num_rounds`` rounds as ONE dispatched program (outer
+        ``lax.scan`` over rounds); returns the per-round honest-dispersion
+        metrics [num_rounds] as a device array.  Identical results to calling
+        :meth:`run_round` in a loop — use this when nothing (eval, logging,
+        checkpointing) needs the params between rounds, e.g. benchmarking."""
+        rounds = jnp.arange(start_round, start_round + num_rounds, dtype=jnp.int32)
+        self.flat_params, self.server_opt_state, variances = self._multi_round_fn(
+            self.flat_params, self.server_opt_state, rounds,
+            self.x_train, self.y_train,
+        )
+        return variances
 
     def train(
         self,
