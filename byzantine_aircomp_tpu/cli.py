@@ -89,8 +89,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--agg-impl",
-        choices=["xla", "pallas"],
-        default="xla",
+        choices=["auto", "xla", "pallas"],
+        default="auto",
         help="Weiszfeld step implementation (pallas = fused TPU kernel)",
     )
     p.add_argument("--dataset", type=str, default="mnist")

@@ -48,7 +48,16 @@ DATA_ROOTS = ("./dataset", "./data", os.path.expanduser("~/datasets"))
 
 @dataclass
 class Dataset:
-    """Normalized train/val arrays, fully materialized."""
+    """Normalized train/val arrays, fully materialized.
+
+    When the underlying pixels are 8-bit (all real datasets here, and the
+    synthetic fallback, which quantizes itself to u8 so both representations
+    agree), ``x_train_raw`` carries them unnormalized with ``stats`` so the
+    trainer can keep the TRAIN set uint8 in HBM — 4x less per-iteration
+    gather traffic than f32 — and fuse ``(u8/255 - mean)/std`` into the
+    client step after the gather.  ``x_train`` stays the normalized f32 view
+    for eval, oracles, and any consumer that wants plain arrays.
+    """
 
     name: str
     x_train: np.ndarray  # [N, ...] float32, normalized
@@ -57,6 +66,8 @@ class Dataset:
     y_val: np.ndarray
     num_classes: int
     source: str  # "disk" or "synthetic"
+    x_train_raw: Optional[np.ndarray] = None  # [N, ...] uint8, unnormalized
+    stats: Optional[Tuple] = None  # (mean, std) per-dataset normalization
 
     @property
     def input_shape(self) -> Tuple[int, ...]:
@@ -124,19 +135,21 @@ def _synthetic_images(
     n: int,
     shape: Tuple[int, ...],
     stats,
-) -> Tuple[np.ndarray, np.ndarray]:
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Deterministic class-conditional images: shared per-class prototypes +
     pixel noise, pushed through the same normalization as real data.  Linearly
     separable enough that the reference models visibly learn, so accuracy
-    curves exercise the full pipeline."""
+    curves exercise the full pipeline.  Pixels are quantized to uint8 before
+    normalization so the raw-u8 and normalized-f32 views agree exactly, like
+    real 8-bit datasets."""
     num_classes = len(protos)
     y = rng.integers(0, num_classes, size=n).astype(np.int32)
     x = protos[y] + 0.35 * rng.standard_normal((n,) + shape).astype(np.float32)
-    x = np.clip(x, 0.0, 1.0)
+    u8 = np.round(np.clip(x, 0.0, 1.0) * 255.0).astype(np.uint8)
     mean, std = stats
     mean = np.asarray(mean, np.float32)
     std = np.asarray(std, np.float32)
-    return (x - mean) / std, y
+    return ((u8.astype(np.float32) / 255.0) - mean) / std, y, u8
 
 
 def _synthetic(name, n_train, n_val, num_classes, shape, stats) -> Dataset:
@@ -144,9 +157,12 @@ def _synthetic(name, n_train, n_val, num_classes, shape, stats) -> Dataset:
     # prototypes are drawn ONCE and shared by train and val — otherwise the
     # val distribution would be unrelated to train and nothing could learn it
     protos = rng.uniform(0.1, 0.9, size=(num_classes,) + shape).astype(np.float32)
-    x_tr, y_tr = _synthetic_images(rng, protos, n_train, shape, stats)
-    x_va, y_va = _synthetic_images(rng, protos, n_val, shape, stats)
-    return Dataset(name, x_tr, y_tr, x_va, y_va, num_classes, "synthetic")
+    x_tr, y_tr, u8_tr = _synthetic_images(rng, protos, n_train, shape, stats)
+    x_va, y_va, _ = _synthetic_images(rng, protos, n_val, shape, stats)
+    return Dataset(
+        name, x_tr, y_tr, x_va, y_va, num_classes, "synthetic",
+        x_train_raw=u8_tr, stats=stats,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -173,6 +189,8 @@ def mnist(synthetic_train: int = 60000, synthetic_val: int = 10000, **_) -> Data
             pair_va[1].astype(np.int32),
             10,
             "disk",
+            x_train_raw=np.ascontiguousarray(pair_tr[0]),
+            stats=MNIST_STATS,
         )
     return _synthetic("mnist", synthetic_train, synthetic_val, 10, (28, 28), MNIST_STATS)
 
@@ -199,6 +217,8 @@ def emnist(synthetic_train: int = 100000, synthetic_val: int = 16000, **_) -> Da
             pair_va[1].astype(np.int32),
             62,
             "disk",
+            x_train_raw=np.ascontiguousarray(pair_tr[0]),
+            stats=EMNIST_STATS,
         )
     return _synthetic(
         "emnist", synthetic_train, synthetic_val, 62, (28, 28), EMNIST_STATS
@@ -229,6 +249,8 @@ def _cifar10_from_bin() -> Optional[Dataset]:
         test[1].astype(np.int32),
         10,
         "disk",
+        x_train_raw=np.ascontiguousarray(x_tr),
+        stats=CIFAR10_STATS,
     )
 
 
@@ -258,6 +280,8 @@ def cifar10(synthetic_train: int = 50000, synthetic_val: int = 10000, **_) -> Da
             np.asarray(d[b"labels"], np.int32),
             10,
             "disk",
+            x_train_raw=np.ascontiguousarray(x_tr),
+            stats=CIFAR10_STATS,
         )
     return _synthetic(
         "cifar10", synthetic_train, synthetic_val, 10, (32, 32, 3), CIFAR10_STATS

@@ -46,9 +46,13 @@ class FedConfig:
     agg_maxiter: int = 1000
     agg_tol: float = 1e-5
     gm_p_max: float = 1.0
-    # "xla" | "pallas": geometric-median Weiszfeld step implementation
-    # (pallas = fused single-HBM-pass TPU kernel, ops/pallas_kernels.py)
-    agg_impl: str = "xla"
+    # "auto" | "xla" | "pallas": geometric-median Weiszfeld step
+    # implementation (pallas = fused single-HBM-pass TPU kernel,
+    # ops/pallas_kernels.py).  "auto" resolves to pallas on a real TPU
+    # backend and xla elsewhere (interpret-mode pallas on CPU is slow);
+    # the sharded trainer forces xla on multi-device meshes (GSPMD
+    # cannot partition pallas_call)
+    agg_impl: str = "auto"
 
     # determinism
     seed: int = 2021
@@ -93,8 +97,8 @@ class FedConfig:
             "byz_size > 0 requires an attack"
         )
         assert self.honest_size > 0, "honest_size must be positive"
-        assert self.agg_impl in ("xla", "pallas"), (
-            f"agg_impl must be 'xla' or 'pallas', got {self.agg_impl!r}"
+        assert self.agg_impl in ("auto", "xla", "pallas"), (
+            f"agg_impl must be 'auto', 'xla' or 'pallas', got {self.agg_impl!r}"
         )
         assert self.local_steps >= 1, "local_steps must be >= 1"
         assert self.server_opt in ("none", "momentum", "adam"), (

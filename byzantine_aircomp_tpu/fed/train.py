@@ -109,9 +109,26 @@ class FedTrainer:
         # a [.., 28, 28] array wastes TPU lane tiling (28 of 128 lanes).
         self._sample_shape = self.dataset.input_shape
         self._spatial_input = getattr(type(self.model), "SPATIAL_INPUT", True)
-        self.x_train = jnp.asarray(self.dataset.x_train).reshape(
-            len(self.dataset.x_train), -1
-        )
+        raw = self.dataset.x_train_raw
+        if raw is not None:
+            # keep the train set uint8 in HBM (4x less random-gather traffic
+            # than f32) and normalize after the gather; per-feature flat
+            # mean/std vectors broadcast correctly for both scalar (MNIST)
+            # and per-channel (CIFAR) statistics
+            self.x_train = jnp.asarray(raw).reshape(len(raw), -1)
+            mean, std = self.dataset.stats
+            shape = self.dataset.input_shape
+            m = np.broadcast_to(np.asarray(mean, np.float32), shape).reshape(-1)
+            s = np.broadcast_to(np.asarray(std, np.float32), shape).reshape(-1)
+            # ((u8/255) - mean)/std folded into one multiply-add per element
+            self._norm_scale = jnp.asarray(1.0 / (255.0 * s))
+            self._norm_bias = jnp.asarray(-m / s)
+        else:
+            self.x_train = jnp.asarray(self.dataset.x_train).reshape(
+                len(self.dataset.x_train), -1
+            )
+            self._norm_scale = None
+            self._norm_bias = None
         self.y_train = jnp.asarray(self.dataset.y_train)
         sharding = data_lib.contiguous_shards(len(self.dataset.x_train), cfg.node_size)
         self.offsets = jnp.asarray(sharding.offsets)
@@ -124,8 +141,13 @@ class FedTrainer:
         self.byz_mask = jnp.asarray(mask)
 
         # effective Weiszfeld impl; the sharded trainer overrides this before
-        # the round fn is first traced (GSPMD cannot partition pallas_call)
+        # the round fn is first traced (GSPMD cannot partition pallas_call).
+        # "auto": the fused pallas step wins ~18% end-to-end on a real TPU
+        # (single HBM pass over [K, d] per Weiszfeld iteration), but pallas
+        # interpret mode on CPU is orders slower than XLA
         self._agg_impl = cfg.agg_impl
+        if self._agg_impl == "auto":
+            self._agg_impl = "pallas" if jax.default_backend() == "tpu" else "xla"
 
         # server optimizer over the pseudo-gradient (FedAvgM / FedAdam);
         # "none" = take the aggregate directly (reference :354-358)
@@ -220,6 +242,11 @@ class FedTrainer:
                 cfg.local_steps * cfg.batch_size,
             )
             x = x_train[idx]  # [K, E*B, features] on-device 2D gather
+            if self._norm_scale is not None:
+                # u8 rows -> normalized floats: same map as the host path
+                # (datasets._normalize) up to float re-association, as one
+                # multiply-add post-gather on device
+                x = x.astype(jnp.float32) * self._norm_scale + self._norm_bias
             shape = (cfg.node_size, cfg.local_steps, cfg.batch_size)
             x = x.reshape(
                 shape + (self._sample_shape if self._spatial_input else (-1,))
@@ -290,9 +317,12 @@ class FedTrainer:
         """n rounds in ONE device program: an outer scan over round indices.
 
         Per-round keys are the same ``fold_in(PRNGKey(seed), round)``
-        derivation as :meth:`run_round`, so ``run_rounds(r0, n)`` is
-        bit-identical to n successive ``run_round`` calls — it only removes
-        the per-round host dispatch (a few ms each on a tunneled chip)."""
+        derivation as :meth:`run_round`, so ``run_rounds(r0, n)`` consumes
+        the identical RNG stream as n successive ``run_round`` calls and
+        removes only the per-round host dispatch (a few ms each on a
+        tunneled chip).  Trajectories agree up to the float re-association
+        of a separately compiled XLA program (ulp-level per step; see
+        tests/test_training.py::test_run_rounds_matches_run_round_loop)."""
         base_key = jax.random.PRNGKey(self.cfg.seed)
 
         def multi_fn(flat_params, opt_state, rounds, x_train, y_train):
@@ -376,9 +406,11 @@ class FedTrainer:
     def run_rounds(self, start_round: int, num_rounds: int) -> jax.Array:
         """Execute ``num_rounds`` rounds as ONE dispatched program (outer
         ``lax.scan`` over rounds); returns the per-round honest-dispersion
-        metrics [num_rounds] as a device array.  Identical results to calling
-        :meth:`run_round` in a loop — use this when nothing (eval, logging,
-        checkpointing) needs the params between rounds, e.g. benchmarking."""
+        metrics [num_rounds] as a device array.  Same RNG stream and
+        semantics as calling :meth:`run_round` in a loop (numerically equal
+        up to separate-compilation float re-association) — use this when
+        nothing (eval, logging, checkpointing) needs the params between
+        rounds, e.g. benchmarking."""
         rounds = jnp.arange(start_round, start_round + num_rounds, dtype=jnp.int32)
         self.flat_params, self.server_opt_state, variances = self._multi_round_fn(
             self.flat_params, self.server_opt_state, rounds,
