@@ -93,7 +93,16 @@ def pairwise_sq_dists(wmatrix: jnp.ndarray) -> jnp.ndarray:
 def krum_scores(wmatrix: jnp.ndarray, honest_size: int) -> jnp.ndarray:
     """Per-client Krum score: sum of the (honest_size - 1) smallest entries of
     its distance row (self-distance 0 included, as in the reference
-    ``:200-202``)."""
+    ``:200-202``).
+
+    The small side is summed DIRECTLY via ``top_k(-dist)`` (float negation
+    is exact; top_k also guards k_sel's range at trace time).  Do not
+    "optimize" this into the complement form ``rowsum - sum(top_k largest)``
+    even though it selects fewer elements when k_sel > K/2: under Byzantine
+    attack the largest squared distances dominate the rowsum by many orders
+    of magnitude, and the f32 subtraction cancels away the small honest
+    distances that decide the argmin (caught in development; guarded by
+    test_krum_scores_outlier_stack_matches_oracle)."""
     dist = pairwise_sq_dists(wmatrix)
     k_sel = honest_size - 2 + 1
     neg_top, _ = jax.lax.top_k(-dist, k_sel)

@@ -157,3 +157,27 @@ def test_channel_dispatch_rule():
 def test_registry_names():
     for name in ["gm", "gm2", "mean", "median", "trimmed_mean", "Krum", "krum", "multi_krum"]:
         assert agg.resolve(name) is not None
+
+
+def test_krum_scores_outlier_stack_matches_oracle():
+    # scores must match the oracle for small and large honest_size on an
+    # OUTLIER-DOMINATED stack — regression against reintroducing the
+    # complement-form shortcut (rowsum - sum of largest), which cancels
+    # catastrophically in f32 exactly when Byzantine rows are huge
+    rng = np.random.default_rng(7)
+    w = rng.normal(size=(K, D)).astype(np.float32)
+    w[-3:] += 50.0  # huge Byzantine rows -> squared distances ~1e5
+    for h in (4, 9, 11):
+        got = np.asarray(agg.krum_scores(jnp.asarray(w), honest_size=h))
+        want = numpy_ref._krum_scores(w, honest_size=h)
+        # rtol covers Gram-matrix vs direct-difference float noise on the
+        # ~1e5-magnitude Byzantine scores; the cancellation bug this guards
+        # against produced relative errors of order 1
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_multi_krum_large_m(wmat):
+    # m > K/2 (the K=1000 m=900 regime scaled down) must match the oracle
+    got = np.asarray(agg.multi_krum(jnp.asarray(wmat), honest_size=9, m=10))
+    want = numpy_ref.multi_krum(wmat, honest_size=9, m=10)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
