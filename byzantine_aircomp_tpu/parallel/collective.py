@@ -106,3 +106,104 @@ def sharded_weiszfeld_step(
         in_specs=(P(CLIENT_AXIS, MODEL_AXIS), P(MODEL_AXIS)),
         out_specs=P(MODEL_AXIS),
     )(w_stack, guess)
+
+
+def ring_krum_scores(
+    mesh: Mesh, w_stack: jnp.ndarray, honest_size: int
+) -> jnp.ndarray:
+    """Krum scores over the sharded [K, d] stack via a ppermute ring.
+
+    The reference computes the full K x K distance matrix on one device
+    (``MNIST_Air_weight.py:199``); at K=1000 x ResNet-18 d the naive sharded
+    equivalent (GSPMD matmul) may all-gather the whole [K, d] stack onto
+    every device.  Here each of the P client-shards keeps its [K/P, d_loc]
+    block resident; over P ring steps the blocks circulate over ICI
+    (``lax.ppermute``) while each device computes one [K/P, K/P] Gram block
+    per step on the MXU — classic ring all-pairs: peak per-device memory
+    O(K/P * (d_loc + K)) and the compute/communication overlap XLA gives
+    ring schedules.  A single end psum over the model axis completes the
+    d-sharded inner products.
+
+    Returns the [K] score vector sharded over the client axis (scores are
+    tiny); argmin/top-k selection on it and the row gather from the sharded
+    stack are left to the caller as GSPMD decisions.
+    """
+    p = mesh.shape[CLIENT_AXIS]
+    k_total = w_stack.shape[0]
+    if k_total % p:
+        raise ValueError(f"K={k_total} not divisible by clients axis ({p})")
+    k_sel = honest_size - 2 + 1  # smallest distances incl. self (ref :200-202)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def local(w):
+        me = jax.lax.axis_index(CLIENT_AXIS)
+        k_loc = w.shape[0]
+        my_sq = jnp.sum(w * w, axis=1)  # [k_loc], partial over d-shard
+
+        def accumulate(rows, blk, blk_sq, s):
+            src = (me - s) % p  # ring position: who this block came from
+            gram = jnp.dot(w, blk.T, preferred_element_type=jnp.float32)
+            part = my_sq[:, None] + blk_sq[None, :] - 2.0 * gram
+            return jax.lax.dynamic_update_slice(rows, part, (0, src * k_loc))
+
+        def body(s, carry):
+            blk, blk_sq, rows = carry
+            rows = accumulate(rows, blk, blk_sq, s)
+            blk = jax.lax.ppermute(blk, CLIENT_AXIS, perm)
+            blk_sq = jax.lax.ppermute(blk_sq, CLIENT_AXIS, perm)
+            return blk, blk_sq, rows
+
+        # the zeros buffer must be marked device-varying before entering the
+        # loop carry (its updates depend on the shard), else the VMA check
+        # rejects the fori_loop carry
+        rows0 = jax.lax.pcast(
+            jnp.zeros((k_loc, k_total), w.dtype),
+            (CLIENT_AXIS, MODEL_AXIS),
+            to="varying",
+        )
+        # p - 1 hops move every block through every device; the last block's
+        # Gram is computed OUTSIDE the loop so no dead final ppermute ships
+        # the whole stack one extra hop (XLA cannot DCE a collective inside
+        # a compiled loop)
+        blk, blk_sq, rows = jax.lax.fori_loop(
+            0, p - 1, body, (w, my_sq, rows0)
+        )
+        rows = accumulate(rows, blk, blk_sq, p - 1)
+        # complete the d-sharded inner products, then clamp float cancellation
+        dist = jnp.maximum(jax.lax.psum(rows, MODEL_AXIS), 0.0)
+        neg_top, _ = jax.lax.top_k(-dist, k_sel)
+        return -jnp.sum(neg_top, axis=1)  # [k_loc]
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=P(CLIENT_AXIS, MODEL_AXIS),
+        out_specs=P(CLIENT_AXIS),
+    )(w_stack)
+
+
+def ring_krum(mesh: Mesh, w_stack: jnp.ndarray, *, honest_size: int, **_):
+    """Single-Krum on the sharded stack.
+
+    The winning row is extracted as a one-hot-weighted column sum rather
+    than ``w_stack[argmin]``: a dynamic row index makes GSPMD all-gather
+    the ENTIRE [K, d] stack onto every device before slicing (verified in
+    HLO), while the one-hot contraction partitions into per-shard psums."""
+    scores = ring_krum_scores(mesh, w_stack, honest_size)
+    sel = jax.nn.one_hot(jnp.argmin(scores), w_stack.shape[0], dtype=w_stack.dtype)
+    return jnp.sum(w_stack * sel[:, None], axis=0)
+
+
+def ring_multi_krum(
+    mesh: Mesh,
+    w_stack: jnp.ndarray,
+    *,
+    honest_size: int,
+    m: Optional[int] = None,
+    **_,
+):
+    """Multi-Krum on the sharded stack: mean of the m lowest-scoring rows."""
+    m_sel = honest_size if m is None else int(m)
+    scores = ring_krum_scores(mesh, w_stack, honest_size)
+    _, idx = jax.lax.top_k(-scores, m_sel)
+    return jnp.mean(w_stack[idx], axis=0)

@@ -53,6 +53,22 @@ class ShardedFedTrainer(FedTrainer):
         # into per-shard psums.  (Set before the round fn's first trace.)
         if self._agg_impl == "pallas" and self.mesh.size > 1:
             self._agg_impl = "xla"
+        # Krum on a client-sharded stack: route through the explicit
+        # ppermute ring (collective.ring_krum*) instead of letting GSPMD
+        # partition the K x K Gram matmul, which can lower to an all-gather
+        # of the whole [K, d] stack onto every device at ResNet scale.
+        # Routing keys off the RESOLVED function (the registry owns name
+        # aliasing), so new aliases cannot silently miss the ring path.
+        if n_clients_axis > 1:
+            from functools import partial
+
+            from ..ops import aggregators as agg_lib
+            from . import collective
+
+            if self.agg_fn is agg_lib.krum:
+                self.agg_fn = partial(collective.ring_krum, self.mesh)
+            elif self.agg_fn is agg_lib.multi_krum:
+                self.agg_fn = partial(collective.ring_multi_krum, self.mesh)
         repl = mesh_lib.sharding(self.mesh, mesh_lib.replicated())
         p_shard = mesh_lib.sharding(self.mesh, mesh_lib.params_spec())
         self.x_train = jax.device_put(self.x_train, repl)

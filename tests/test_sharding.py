@@ -131,3 +131,27 @@ def test_sharded_trainer_rejects_uneven_clients():
             dataset=data_lib.load("mnist", synthetic_train=400, synthetic_val=100),
             mesh=mesh_lib.make_mesh(),
         )
+
+
+@pytest.mark.parametrize("model_parallel", [1, 2])
+def test_ring_krum_scores_match_dense(model_parallel):
+    # ring all-pairs over ppermute must reproduce the dense Gram-matrix
+    # scores on every mesh layout, including an outlier-dominated stack
+    m = mesh_lib.make_mesh(model_parallel=model_parallel)
+    w = jax.random.normal(jax.random.PRNGKey(4), (16, 256))
+    w = w.at[-4:].add(25.0)
+    got = collective.ring_krum_scores(m, w, honest_size=11)
+    want = agg_lib.krum_scores(w, honest_size=11)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-3, atol=1e-3)
+
+
+def test_ring_krum_and_multi_krum_match_dense():
+    m = mesh_lib.make_mesh(model_parallel=2)
+    w = jax.random.normal(jax.random.PRNGKey(5), (16, 256))
+    w = w.at[-4:].mul(30.0)
+    got = collective.ring_krum(m, w, honest_size=11)
+    want = agg_lib.krum(w, honest_size=11)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+    got_m = collective.ring_multi_krum(m, w, honest_size=11, m=11)
+    want_m = agg_lib.multi_krum(w, honest_size=11, m=11)
+    np.testing.assert_allclose(np.asarray(got_m), np.asarray(want_m), rtol=1e-4, atol=1e-5)
