@@ -63,8 +63,19 @@ def test_air_sum_equals_oma2(noise_var, model_parallel):
 
 
 @pytest.mark.parametrize("model_parallel", [1, 2])
-@pytest.mark.parametrize("agg", ["mean", "gm2", "trimmed_mean", "krum"])
-def test_sharded_trainer_matches_single_device(agg, model_parallel):
+@pytest.mark.parametrize(
+    "agg,noise_var",
+    [
+        ("mean", None),
+        ("gm2", None),
+        ("trimmed_mean", None),
+        ("krum", None),
+        # the paper's headline AirComp mode: gm with OMA2 noise inside every
+        # Weiszfeld step (--var); identical RNG streams on both paths
+        ("gm", 1e-3),
+    ],
+)
+def test_sharded_trainer_matches_single_device(agg, noise_var, model_parallel):
     """The core CI gate: identical results sharded vs single-device vmap."""
     ds = data_lib.load("mnist", synthetic_train=1600, synthetic_val=320)
     kw = dict(
@@ -75,6 +86,7 @@ def test_sharded_trainer_matches_single_device(agg, model_parallel):
         display_interval=3,
         batch_size=16,
         agg=agg,
+        noise_var=noise_var,
         eval_train=False,
         agg_maxiter=50,
     )
