@@ -130,3 +130,26 @@ def weightflip(w: np.ndarray, byz_size: int) -> np.ndarray:
     s = w[:-byz_size].sum(axis=0)
     out[-byz_size:] = -w[-byz_size:] - 2.0 * s / byz_size
     return out
+
+
+def bulyan(w: np.ndarray, honest_size: int) -> np.ndarray:
+    """Oracle for the framework's batch Bulyan (an extension — the reference
+    ships single-Krum only): theta = K - 2B lowest Krum scores selected, then
+    per coordinate the beta = theta - 2B values closest to the selection's
+    (lower-middle) median are averaged."""
+    k = len(w)
+    b = k - honest_size
+    theta = k - 2 * b
+    beta = theta - 2 * b
+    if beta < 1:  # same K > 4B contract as the JAX path
+        raise ValueError(
+            f"bulyan needs K > 4B (K={k}, B={b} -> theta={theta}, beta={beta})"
+        )
+    idx = np.argsort(_krum_scores(w, honest_size))[:theta]
+    sel = w[idx]
+    med = median(sel)
+    out = np.empty(w.shape[1], np.float32)
+    for j in range(w.shape[1]):
+        order = np.argsort(np.abs(sel[:, j] - med[j]), kind="stable")[:beta]
+        out[j] = sel[order, j].mean()
+    return out

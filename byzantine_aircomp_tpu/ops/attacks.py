@@ -21,7 +21,10 @@ honest and Byzantine clients), and the message transform maps
 ``byz_size`` rows, matching the reference's layout (``:291-341``).
 
 Beyond the reference's three attacks we ship ``signflip``, ``gradascent`` and
-``gaussian`` per the BASELINE.json scale-up configs.
+``gaussian`` per the BASELINE.json scale-up configs, plus two standard
+omniscient attacks from the Byzantine literature: ``alie`` ("A Little Is
+Enough", Baruch et al. 2019) and ``ipm`` (Inner-Product Manipulation, Xie
+et al. 2020).
 """
 
 from __future__ import annotations
@@ -94,6 +97,30 @@ def _gaussian_message(wmatrix, byz_size, key, sigma: float = 1.0):
     return jnp.concatenate([wmatrix[:-byz_size], byz], axis=0)
 
 
+def _alie_message(wmatrix, byz_size, key, z: float = 1.5):
+    # "A Little Is Enough" (Baruch et al., NeurIPS 2019): Byzantine rows sit
+    # z honest standard deviations from the honest mean per coordinate —
+    # small enough to pass median/Krum-style filters, consistent enough to
+    # drag the aggregate.  Omniscient (uses honest-row statistics), like
+    # weightflip.
+    honest = wmatrix[:-byz_size]
+    mu = jnp.mean(honest, axis=0)
+    sigma = jnp.std(honest, axis=0)
+    byz = jnp.broadcast_to(mu - z * sigma, wmatrix[-byz_size:].shape)
+    return jnp.concatenate([honest, byz], axis=0)
+
+
+def _ipm_message(wmatrix, byz_size, key, eps: float = 0.5):
+    # Inner-Product Manipulation (Xie et al., UAI 2020): Byzantine rows are
+    # -eps times the honest mean, making the aggregate's inner product with
+    # the true descent direction negative for mean-style rules when
+    # eps * B > K - B is engineered, and slowing convergence otherwise.
+    honest = wmatrix[:-byz_size]
+    mu = jnp.mean(honest, axis=0)
+    byz = jnp.broadcast_to(-eps * mu, wmatrix[-byz_size:].shape)
+    return jnp.concatenate([honest, byz], axis=0)
+
+
 ATTACKS.register("classflip")(AttackSpec("classflip", data_fn=_classflip_data))
 ATTACKS.register("dataflip")(AttackSpec("dataflip", data_fn=_dataflip_data))
 ATTACKS.register("weightflip")(
@@ -101,6 +128,8 @@ ATTACKS.register("weightflip")(
 )
 ATTACKS.register("signflip")(AttackSpec("signflip", message_fn=_signflip_message))
 ATTACKS.register("gradascent")(AttackSpec("gradascent", grad_scale=-1.0))
+ATTACKS.register("alie")(AttackSpec("alie", message_fn=_alie_message))
+ATTACKS.register("ipm")(AttackSpec("ipm", message_fn=_ipm_message))
 ATTACKS.register("gaussian")(AttackSpec("gaussian", message_fn=_gaussian_message))
 
 

@@ -207,3 +207,27 @@ def ring_multi_krum(
     scores = ring_krum_scores(mesh, w_stack, honest_size)
     _, idx = jax.lax.top_k(-scores, m_sel)
     return jnp.mean(w_stack[idx], axis=0)
+
+
+def ring_bulyan(
+    mesh: Mesh, w_stack: jnp.ndarray, *, honest_size: int, **_
+):
+    """Bulyan on the client-sharded stack.
+
+    Krum scores come from the ppermute ring; the theta selected rows are
+    extracted as a one-hot [theta, K] x [K, d] contraction (GSPMD partitions
+    it into per-shard psums over the client axis — a dynamic ``w_stack[idx]``
+    gather would all-gather the whole stack), leaving the [theta, d]
+    selection sharded over the model axis; the coordinatewise
+    median/trim/mean tail partitions over d untouched.
+    """
+    from ..ops import aggregators as agg_lib
+
+    k = w_stack.shape[0]
+    b = k - honest_size
+    theta, beta = agg_lib._bulyan_sizes(k, b)
+    scores = ring_krum_scores(mesh, w_stack, honest_size)
+    _, idx = jax.lax.top_k(-scores, theta)
+    sel_mat = jax.nn.one_hot(idx, k, dtype=w_stack.dtype)  # [theta, K]
+    sel = jnp.dot(sel_mat, w_stack, preferred_element_type=jnp.float32)
+    return agg_lib._bulyan_tail(sel, beta)

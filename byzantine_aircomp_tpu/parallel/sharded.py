@@ -69,6 +69,8 @@ class ShardedFedTrainer(FedTrainer):
                 self.agg_fn = partial(collective.ring_krum, self.mesh)
             elif self.agg_fn is agg_lib.multi_krum:
                 self.agg_fn = partial(collective.ring_multi_krum, self.mesh)
+            elif self.agg_fn is agg_lib.bulyan:
+                self.agg_fn = partial(collective.ring_bulyan, self.mesh)
         repl = mesh_lib.sharding(self.mesh, mesh_lib.replicated())
         p_shard = mesh_lib.sharding(self.mesh, mesh_lib.params_spec())
         self.x_train = jax.device_put(self.x_train, repl)

@@ -181,3 +181,35 @@ def test_multi_krum_large_m(wmat):
     got = np.asarray(agg.multi_krum(jnp.asarray(wmat), honest_size=9, m=10))
     want = numpy_ref.multi_krum(wmat, honest_size=9, m=10)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_bulyan_matches_oracle(wmat):
+    got = np.asarray(agg.bulyan(jnp.asarray(wmat), honest_size=10))
+    want = numpy_ref.bulyan(wmat, honest_size=10)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_bulyan_resists_alie_better_than_mean():
+    # honest rows cluster near 1.0; ALIE-style rows sit below the honest
+    # mean (6 sigma here — exaggerated vs the attack's z=1.5 default so the
+    # mean-drag margin is unambiguous). Bulyan's output must stay near the
+    # honest mean while the plain mean is dragged.
+    rng = np.random.default_rng(21)
+    honest = (1.0 + 0.05 * rng.normal(size=(17, 40))).astype(np.float32)
+    mu, sigma = honest.mean(0), honest.std(0)
+    byz = np.broadcast_to(mu - 6 * sigma, (3, 40)).astype(np.float32)
+    w = np.concatenate([honest, byz])
+    out = np.asarray(agg.bulyan(jnp.asarray(w), honest_size=17))
+    assert np.abs(out - mu).max() < 0.1
+    drag = np.abs(w.mean(0) - mu).max()
+    assert drag > np.abs(out - mu).max()
+
+
+def test_bulyan_rejects_k_too_small():
+    w = np.zeros((8, 5), np.float32)
+    with pytest.raises(ValueError):
+        agg.bulyan(jnp.asarray(w), honest_size=3)  # B=5 -> K <= 2B
+    with pytest.raises(ValueError):
+        # 2B < K <= 4B: selection nonempty but trimmed set would be empty —
+        # must raise rather than silently degrade to the median
+        agg.bulyan(jnp.asarray(np.zeros((10, 5), np.float32)), honest_size=7)

@@ -83,3 +83,28 @@ def test_zero_byz_is_identity():
     w = jnp.ones((5, 3))
     spec = attacks.resolve("weightflip")
     np.testing.assert_array_equal(np.asarray(spec.apply_message(w, 0)), np.asarray(w))
+
+
+def test_alie_rows_sit_z_sigmas_from_honest_mean():
+    rng = np.random.default_rng(11)
+    w = rng.normal(size=(10, 33)).astype(np.float32)
+    spec = attacks.resolve("alie")
+    out = np.asarray(spec.apply_message(jnp.asarray(w), 3))
+    # honest rows untouched
+    np.testing.assert_array_equal(out[:7], w[:7])
+    mu, sigma = w[:7].mean(0), w[:7].std(0)
+    for r in range(7, 10):
+        np.testing.assert_allclose(out[r], mu - 1.5 * sigma, rtol=1e-5, atol=1e-5)
+
+
+def test_ipm_rows_negate_scaled_honest_mean():
+    rng = np.random.default_rng(12)
+    w = rng.normal(size=(10, 33)).astype(np.float32)
+    spec = attacks.resolve("ipm")
+    out = np.asarray(spec.apply_message(jnp.asarray(w), 4))
+    np.testing.assert_array_equal(out[:6], w[:6])
+    mu = w[:6].mean(0)
+    for r in range(6, 10):
+        np.testing.assert_allclose(out[r], -0.5 * mu, rtol=1e-5, atol=1e-6)
+    # the corrupted mean's inner product with the honest mean shrinks
+    assert np.dot(out.mean(0), mu) < np.dot(w[:6].mean(0), mu)

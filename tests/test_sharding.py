@@ -73,6 +73,9 @@ def test_air_sum_equals_oma2(noise_var, model_parallel):
         # the paper's headline AirComp mode: gm with OMA2 noise inside every
         # Weiszfeld step (--var); identical RNG streams on both paths
         ("gm", 1e-3),
+        # exercises the trainer's bulyan -> ring_bulyan dispatch branch
+        # (K=16, B=3 satisfies K > 4B)
+        ("bulyan", None),
     ],
 )
 def test_sharded_trainer_matches_single_device(agg, noise_var, model_parallel):
@@ -167,3 +170,12 @@ def test_ring_krum_and_multi_krum_match_dense():
     got_m = collective.ring_multi_krum(m, w, honest_size=11, m=11)
     want_m = agg_lib.multi_krum(w, honest_size=11, m=11)
     np.testing.assert_allclose(np.asarray(got_m), np.asarray(want_m), rtol=1e-4, atol=1e-5)
+
+
+def test_ring_bulyan_matches_dense():
+    m = mesh_lib.make_mesh(model_parallel=2)
+    w = jax.random.normal(jax.random.PRNGKey(6), (16, 256))
+    w = w.at[-2:].add(20.0)  # B=2 outliers, K=16 > 4B
+    got = collective.ring_bulyan(m, w, honest_size=14)
+    want = agg_lib.bulyan(w, honest_size=14)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
