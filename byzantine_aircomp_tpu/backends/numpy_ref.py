@@ -153,3 +153,37 @@ def bulyan(w: np.ndarray, honest_size: int) -> np.ndarray:
         order = np.argsort(np.abs(sel[:, j] - med[j]), kind="stable")[:beta]
         out[j] = sel[order, j].mean()
     return out
+
+
+def centered_clip(
+    w: np.ndarray,
+    guess: Optional[np.ndarray] = None,
+    clip_tau: float = 10.0,
+    clip_iters: int = 3,
+) -> np.ndarray:
+    """Oracle for the framework's centered-clipping aggregator (an
+    extension; Karimireddy et al. 2021): v += mean(clip(w_i - v, tau))."""
+    v = w.mean(axis=0) if guess is None else np.asarray(guess, np.float64)
+    for _ in range(clip_iters):
+        delta = w - v[None, :]
+        norms = np.maximum(np.linalg.norm(delta, axis=1), 1e-12)
+        scale = np.minimum(1.0, clip_tau / norms)
+        v = v + (delta * scale[:, None]).mean(axis=0)
+    return v.astype(np.float32)
+
+
+def alie(w: np.ndarray, byz_size: int, z: float = 1.5) -> np.ndarray:
+    """Oracle for the framework's ALIE attack: Byzantine rows at
+    mu_honest - z * sigma_honest per coordinate."""
+    out = w.copy()
+    honest = w[:-byz_size]
+    out[-byz_size:] = honest.mean(axis=0) - z * honest.std(axis=0)
+    return out
+
+
+def ipm(w: np.ndarray, byz_size: int, eps: float = 0.5) -> np.ndarray:
+    """Oracle for the framework's IPM attack: Byzantine rows at
+    -eps * mean(honest)."""
+    out = w.copy()
+    out[-byz_size:] = -eps * w[:-byz_size].mean(axis=0)
+    return out

@@ -73,7 +73,10 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
     if cfg.attack is None:
         cfg.byz_size = 0
     cfg.validate()
-    _KNOWN_ATTACKS = {"classflip", "dataflip", "gradascent", "weightflip", "signflip"}
+    _KNOWN_ATTACKS = {
+        "classflip", "dataflip", "gradascent", "weightflip", "signflip",
+        "alie", "ipm", "gaussian",
+    }
     if cfg.attack is not None and cfg.attack not in _KNOWN_ATTACKS:
         raise KeyError(
             f"ref backend: unknown attack {cfg.attack!r}; known: "
@@ -128,6 +131,14 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
                 w_stack = numpy_ref.weightflip(w_stack, cfg.byz_size)
             elif cfg.attack == "signflip" and cfg.byz_size:
                 w_stack[-cfg.byz_size :] *= -1.0
+            elif cfg.attack == "alie" and cfg.byz_size:
+                w_stack = numpy_ref.alie(w_stack, cfg.byz_size)
+            elif cfg.attack == "ipm" and cfg.byz_size:
+                w_stack = numpy_ref.ipm(w_stack, cfg.byz_size)
+            elif cfg.attack == "gaussian" and cfg.byz_size:
+                w_stack[-cfg.byz_size :] = rng.normal(
+                    size=(cfg.byz_size, flat.size)
+                ).astype(np.float32)
 
             if cfg.noise_var is not None and cfg.agg != "gm":
                 w_stack = numpy_ref.oma(rng, w_stack, cfg.noise_var)
@@ -156,6 +167,10 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
                 flat = numpy_ref.krum(w_stack, cfg.honest_size).copy()
             elif cfg.agg == "multi_krum":
                 flat = numpy_ref.multi_krum(w_stack, cfg.honest_size)
+            elif cfg.agg == "bulyan":
+                flat = numpy_ref.bulyan(w_stack, cfg.honest_size)
+            elif cfg.agg == "cclip":
+                flat = numpy_ref.centered_clip(w_stack, guess=flat)
             else:
                 raise KeyError(f"ref backend: unknown aggregator {cfg.agg!r}")
 

@@ -133,6 +133,39 @@ def multi_krum(
     return jnp.mean(wmatrix[idx], axis=0)
 
 
+@AGGREGATORS.register("cclip")
+def centered_clip(
+    wmatrix: jnp.ndarray,
+    *,
+    guess: Optional[jnp.ndarray] = None,
+    clip_tau: float = 10.0,
+    clip_iters: int = 3,
+    **_,
+) -> jnp.ndarray:
+    """Centered clipping (Karimireddy, He & Jaggi, ICML 2021) — not in the
+    reference; included as the standard momentum-style defense.  Starting
+    from the pre-round global params (the ``guess`` every aggregator already
+    receives, reference ``:349-350``), each of the ``clip_iters`` fixed
+    steps moves the center by the mean of the client deltas clipped to
+    radius ``clip_tau``:
+
+        v <- v + mean_i( (w_i - v) * min(1, tau / ||w_i - v||) )
+
+    A single Byzantine row can displace the center by at most tau/K per
+    step, whatever its magnitude.  The fixed small iteration count keeps the
+    program static (no data-dependent while_loop needed at this cost)."""
+    v = _centroid(wmatrix) if guess is None else guess
+
+    def step(v, _):
+        delta = wmatrix - v[None, :]
+        norms = jnp.maximum(jnp.linalg.norm(delta, axis=1), 1e-12)
+        scale = jnp.minimum(1.0, clip_tau / norms)
+        return v + jnp.mean(delta * scale[:, None], axis=0), None
+
+    v, _ = jax.lax.scan(step, v, None, length=clip_iters)
+    return v
+
+
 @AGGREGATORS.register("bulyan")
 def bulyan(
     wmatrix: jnp.ndarray, *, honest_size: int, **_
@@ -149,14 +182,14 @@ def bulyan(
     """
     k = wmatrix.shape[0]
     b = k - honest_size
-    theta, beta = _bulyan_sizes(k, b)
+    theta, beta = bulyan_sizes(k, b)
     scores = krum_scores(wmatrix, honest_size)
     _, idx = jax.lax.top_k(-scores, theta)
     sel = wmatrix[idx]  # [theta, d]
-    return _bulyan_tail(sel, beta)
+    return bulyan_tail(sel, beta)
 
 
-def _bulyan_sizes(k: int, b: int):
+def bulyan_sizes(k: int, b: int):
     """(theta, beta) for Bulyan at K clients / B Byzantine; raises unless
     K > 4B so both the selection and the trimmed set are nonempty."""
     theta = k - 2 * b
@@ -169,7 +202,7 @@ def _bulyan_sizes(k: int, b: int):
     return theta, beta
 
 
-def _bulyan_tail(sel: jnp.ndarray, beta: int) -> jnp.ndarray:
+def bulyan_tail(sel: jnp.ndarray, beta: int) -> jnp.ndarray:
     """Coordinatewise Bulyan aggregation over the selected [theta, d] rows:
     average the beta values closest to the (lower-middle) median.  Pure
     coordinatewise ops — partitions over a d-sharded ``sel`` untouched."""

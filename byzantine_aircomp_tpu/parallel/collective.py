@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops import aggregators as agg_ops
 from ..ops import channel
 from .mesh import CLIENT_AXIS, MODEL_AXIS
 
@@ -221,13 +222,11 @@ def ring_bulyan(
     selection sharded over the model axis; the coordinatewise
     median/trim/mean tail partitions over d untouched.
     """
-    from ..ops import aggregators as agg_lib
-
     k = w_stack.shape[0]
     b = k - honest_size
-    theta, beta = agg_lib._bulyan_sizes(k, b)
+    theta, beta = agg_ops.bulyan_sizes(k, b)
     scores = ring_krum_scores(mesh, w_stack, honest_size)
     _, idx = jax.lax.top_k(-scores, theta)
     sel_mat = jax.nn.one_hot(idx, k, dtype=w_stack.dtype)  # [theta, K]
     sel = jnp.dot(sel_mat, w_stack, preferred_element_type=jnp.float32)
-    return agg_lib._bulyan_tail(sel, beta)
+    return agg_ops.bulyan_tail(sel, beta)

@@ -213,3 +213,26 @@ def test_bulyan_rejects_k_too_small():
         # 2B < K <= 4B: selection nonempty but trimmed set would be empty —
         # must raise rather than silently degrade to the median
         agg.bulyan(jnp.asarray(np.zeros((10, 5), np.float32)), honest_size=7)
+
+
+def test_centered_clip_matches_oracle(wmat):
+    guess = wmat.mean(0) + 0.1
+    got = np.asarray(agg.centered_clip(jnp.asarray(wmat), guess=jnp.asarray(guess)))
+    want = numpy_ref.centered_clip(wmat, guess=guess)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_centered_clip_bounds_outlier_influence():
+    # one arbitrarily huge Byzantine row moves the center by at most
+    # tau/K per iteration, regardless of its magnitude
+    rng = np.random.default_rng(31)
+    honest = (0.01 * rng.normal(size=(19, 40))).astype(np.float32)
+    w = np.concatenate([honest, np.full((1, 40), 1e8, np.float32)])
+    guess = np.zeros(40, np.float32)
+    out = np.asarray(
+        agg.centered_clip(jnp.asarray(w), guess=jnp.asarray(guess), clip_tau=1.0)
+    )
+    # 3 iterations x tau/K = 0.15 worst-case displacement from the attacker
+    assert np.linalg.norm(out - honest.mean(0)) < 3 * 1.0 / 20 + 0.05
+    # the plain mean is destroyed
+    assert np.linalg.norm(w.mean(0) - honest.mean(0)) > 1e6

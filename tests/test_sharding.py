@@ -76,6 +76,7 @@ def test_air_sum_equals_oma2(noise_var, model_parallel):
         # exercises the trainer's bulyan -> ring_bulyan dispatch branch
         # (K=16, B=3 satisfies K > 4B)
         ("bulyan", None),
+        ("cclip", None),
     ],
 )
 def test_sharded_trainer_matches_single_device(agg, noise_var, model_parallel):
