@@ -33,6 +33,7 @@ ARG_TO_FIELD = {
     "inherit": ("inherit", None),
     "sharding": ("sharded", _SHARDING.get),
     "agg_impl": ("agg_impl", None),
+    "prng_impl": ("prng_impl", None),
     "profile_dir": ("profile_dir", None),
     "model_parallel": ("model_parallel", None),
     "rounds": ("rounds", None),
@@ -92,6 +93,12 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["auto", "xla", "pallas"],
         default="auto",
         help="Weiszfeld step implementation (pallas = fused TPU kernel)",
+    )
+    p.add_argument(
+        "--prng-impl",
+        choices=["threefry", "rbg", "unsafe_rbg"],
+        default="threefry",
+        help="per-round PRNG stream (rbg = fast TPU hardware RNG path)",
     )
     p.add_argument("--dataset", type=str, default="mnist")
     p.add_argument("--model", type=str, default="MLP")

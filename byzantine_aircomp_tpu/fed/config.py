@@ -57,6 +57,13 @@ class FedConfig:
     # determinism
     seed: int = 2021
     fix_seed: bool = True
+    # PRNG implementation for the per-round key stream: "threefry"
+    # (default - splittable, identical across backends) or "rbg" /
+    # "unsafe_rbg" (hardware RNG path, much cheaper key derivation and
+    # sampling on TPU; streams differ from threefry, so use for
+    # throughput, not cross-backend reproducibility).  Model init always
+    # uses threefry so initial params are impl-independent.
+    prng_impl: str = "threefry"
 
     # model / data
     model: str = "MLP"
@@ -99,6 +106,10 @@ class FedConfig:
         assert self.honest_size > 0, "honest_size must be positive"
         assert self.agg_impl in ("auto", "xla", "pallas"), (
             f"agg_impl must be 'auto', 'xla' or 'pallas', got {self.agg_impl!r}"
+        )
+        assert self.prng_impl in ("threefry", "rbg", "unsafe_rbg"), (
+            f"prng_impl must be 'threefry', 'rbg' or 'unsafe_rbg', "
+            f"got {self.prng_impl!r}"
         )
         assert self.local_steps >= 1, "local_steps must be >= 1"
         assert self.server_opt in ("none", "momentum", "adam"), (

@@ -94,9 +94,12 @@ class FedTrainer:
             model_kw["fc_width"] = cfg.fc_width
         self.model = MODELS.get(cfg.model)(**model_kw)
 
-        # init params (reference modelFactory + setup_seed(2021), :98-104)
+        # init params (reference modelFactory + setup_seed(2021), :98-104).
+        # The impl is pinned so a global jax_default_prng_impl override
+        # cannot change initial params.
         sample = jnp.zeros((1,) + self.dataset.input_shape, jnp.float32)
-        params = self.model.init(jax.random.PRNGKey(cfg.seed), sample)
+        init_key = jax.random.key(cfg.seed, impl="threefry2x32")
+        params = self.model.init(init_key, sample)
         self.spec = flatten_lib.make_flat_spec(params)
         self.flat_params = flatten_lib.flatten(params, self.spec)
         self.dim = self.spec.total
@@ -160,6 +163,16 @@ class FedTrainer:
         self.server_opt_state = (
             self._server_tx.init(self.flat_params) if self._server_tx else ()
         )
+
+        # per-round key stream; model init above stays threefry so initial
+        # params are identical whatever impl drives the round RNG.  Typed
+        # keys (jax.random.key) carry their impl — a raw PRNGKey array of a
+        # non-default impl would be misinterpreted by downstream consumers.
+        # "threefry" pins threefry2x32 explicitly (impl=None would follow
+        # the PROCESS-default jax_default_prng_impl, breaking the replay
+        # guarantee under a global override)
+        impl = "threefry2x32" if cfg.prng_impl == "threefry" else cfg.prng_impl
+        self._base_key = jax.random.key(cfg.seed, impl=impl)
 
         self._round_fn = jax.jit(self._build_round_fn(), donate_argnums=(0, 1))
         self._multi_round_fn = jax.jit(
@@ -323,7 +336,7 @@ class FedTrainer:
         tunneled chip).  Trajectories agree up to the float re-association
         of a separately compiled XLA program (ulp-level per step; see
         tests/test_training.py::test_run_rounds_matches_run_round_loop)."""
-        base_key = jax.random.PRNGKey(self.cfg.seed)
+        base_key = self._base_key
 
         def multi_fn(flat_params, opt_state, rounds, x_train, y_train):
             def body(carry, r):
@@ -396,7 +409,7 @@ class FedTrainer:
         round would serialize dispatch on the device round-trip latency
         (~3x the round's compute on a tunneled chip); callers convert when
         they actually consume the value."""
-        round_key = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), round_idx)
+        round_key = jax.random.fold_in(self._base_key, round_idx)
         self.flat_params, self.server_opt_state, variance = self._round_fn(
             self.flat_params, self.server_opt_state, round_key,
             self.x_train, self.y_train,

@@ -108,3 +108,12 @@ def test_ipm_rows_negate_scaled_honest_mean():
         np.testing.assert_allclose(out[r], -0.5 * mu, rtol=1e-5, atol=1e-6)
     # the corrupted mean's inner product with the honest mean shrinks
     assert np.dot(out.mean(0), mu) < np.dot(w[:6].mean(0), mu)
+
+
+def test_alie_ipm_oracles_match_jax_attacks():
+    rng = np.random.default_rng(13)
+    w = rng.normal(size=(12, 29)).astype(np.float32)
+    for name, oracle in (("alie", numpy_ref.alie), ("ipm", numpy_ref.ipm)):
+        spec = attacks.resolve(name)
+        got = np.asarray(spec.apply_message(jnp.asarray(w), 3))
+        np.testing.assert_allclose(got, oracle(w, 3), rtol=1e-5, atol=1e-6)

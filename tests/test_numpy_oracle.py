@@ -52,3 +52,20 @@ def test_gm_converges_with_noise():
     out = numpy_ref.gm(np.random.default_rng(6), w, noise_var=1e-2, guess=g.copy())
     assert np.isfinite(out).all()
     assert np.linalg.norm(out - w.mean(axis=0)) < 0.1
+
+
+def test_ref_backend_new_attack_and_agg_branches():
+    # exercises the ref trainer's alie/ipm/gaussian attack branches and the
+    # bulyan/cclip aggregator branches end-to-end (tiny runs)
+    from byzantine_aircomp_tpu.backends.ref_trainer import run_ref
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+
+    ds = data_lib.load("mnist", synthetic_train=600, synthetic_val=200)
+    for attack, agg in (("alie", "bulyan"), ("ipm", "cclip"), ("gaussian", "median")):
+        cfg = FedConfig(
+            honest_size=17, byz_size=3, attack=attack, agg=agg,
+            rounds=1, display_interval=2, batch_size=16, eval_train=False,
+        )
+        paths = run_ref(cfg, log_fn=lambda s: None, dataset=ds)
+        assert np.isfinite(paths["valLossPath"]).all(), (attack, agg)

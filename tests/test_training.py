@@ -144,3 +144,13 @@ def test_run_rounds_matches_run_round_loop():
     np.testing.assert_allclose(
         np.asarray(a.flat_params), np.asarray(b.flat_params), rtol=2e-3, atol=1e-6
     )
+
+
+def test_rbg_prng_stream_trains():
+    # the rbg hardware-RNG stream is an alternative to threefry for
+    # throughput; it must train and be deterministic within itself
+    cfg = make_cfg(rounds=2, prng_impl="rbg")
+    a = run_short(cfg)
+    b = run_short(cfg)
+    np.testing.assert_allclose(a["valAccPath"], b["valAccPath"], atol=1e-6)
+    assert a["valAccPath"][-1] > 0.3
