@@ -31,6 +31,30 @@ def log(msg: str) -> None:
 
 
 def main() -> None:
+    # Watchdog: a wedged device tunnel can block JAX backend init (or any
+    # dispatch) indefinitely, which would hang the whole bench harness.  A
+    # healthy TPU run finishes in ~2-3 min incl. compiles; if we are still
+    # alive at the deadline something is wedged — exit non-zero instead of
+    # hanging.  Override for legitimately slow environments (e.g. a CPU
+    # smoke run of the K=1000 config) with BENCH_WATCHDOG_SECS; 0 disables.
+    import os
+    import threading
+
+    deadline = float(os.environ.get("BENCH_WATCHDOG_SECS", "900"))
+
+    def _abort():
+        print(
+            f"bench: WATCHDOG — no completion after {deadline:.0f}s, aborting",
+            file=sys.stderr,
+        )
+        sys.stderr.flush()
+        os._exit(3)
+
+    watchdog = threading.Timer(deadline, _abort)
+    watchdog.daemon = True
+    if deadline > 0:
+        watchdog.start()
+
     import jax
 
     from byzantine_aircomp_tpu.data import datasets as data_lib
@@ -83,6 +107,7 @@ def main() -> None:
     log(f"bench: {TIMED_ROUNDS} rounds in {dt:.3f}s -> {rps:.2f} rounds/sec "
         f"(val_loss={loss:.4f} val_acc={acc:.4f})")
 
+    watchdog.cancel()
     print(
         json.dumps(
             {
