@@ -1,0 +1,96 @@
+"""Aggregator micro-benchmarks over a [K, d] client stack.
+
+Measures per-call latency of every registered aggregator (and both Weiszfeld
+implementations) on synthetic stacks shaped like the north-star config, e.g.:
+
+    python benchmarks/agg_bench.py --k 1000 --d 7850 --iters 30
+
+Prints one JSON line per (aggregator, impl) with mean/best milliseconds.
+Unlike bench.py (the driver-facing end-to-end number), this isolates the
+server-side reduction cost — the tool used to decide agg_impl defaults
+(docs/PERFORMANCE.md).  Works on any backend; on CPU the pallas rows run in
+interpret mode and are expected to be slow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def bench_one(fn, args, iters: int):
+    jax.block_until_ready(fn(*args))  # compile + sync
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return sum(times) / len(times) * 1e3, min(times) * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=1000)
+    ap.add_argument("--d", type=int, default=7850)
+    ap.add_argument("--byz", type=int, default=100)
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--maxiter", type=int, default=1000, help="Weiszfeld cap")
+    ap.add_argument(
+        "--skip-pallas", action="store_true",
+        help="skip pallas rows (interpret mode on CPU is very slow)",
+    )
+    args = ap.parse_args()
+
+    from byzantine_aircomp_tpu.ops import aggregators as agg_lib
+
+    key = jax.random.PRNGKey(0)
+    honest = args.k - args.byz
+    # realistic stack: tight honest cluster one SGD step apart + byz outliers
+    base = jax.random.normal(jax.random.fold_in(key, 1), (args.d,)) * 0.05
+    w = base[None, :] + 1e-3 * jax.random.normal(
+        jax.random.fold_in(key, 2), (args.k, args.d)
+    )
+    w = w.at[honest:].mul(-1.0)  # signflip-style Byzantine rows
+    w = jax.block_until_ready(w.astype(jnp.float32))
+    guess = jax.block_until_ready(base.astype(jnp.float32))
+
+    common = dict(
+        honest_size=honest, noise_var=1e-2, maxiter=args.maxiter, tol=1e-5
+    )
+    cases = []
+    for name in ["mean", "median", "trimmed_mean", "krum", "multi_krum",
+                 "bulyan", "cclip", "gm2", "gm"]:
+        impls = ["xla"]
+        if name in ("gm", "gm2") and not args.skip_pallas:
+            from byzantine_aircomp_tpu.ops import pallas_kernels
+
+            if pallas_kernels.supports_fused(args.d):
+                impls.append("pallas")
+            else:
+                print(f"# skipping {name}/pallas: d={args.d} exceeds the "
+                      "fused-VMEM cap (would silently fall back to xla)")
+        for impl in impls:
+            fn = agg_lib.resolve(name)
+
+            def run(w, guess, key, fn=fn, impl=impl):
+                return fn(w, guess=guess, key=key, impl=impl, **common)
+
+            cases.append((name, impl, jax.jit(run)))
+
+    print(f"# backend={jax.default_backend()} K={args.k} d={args.d} "
+          f"B={args.byz} iters={args.iters}")
+    for name, impl, fn in cases:
+        mean_ms, best_ms = bench_one(fn, (w, guess, key), args.iters)
+        print(json.dumps({
+            "agg": name, "impl": impl,
+            "mean_ms": round(mean_ms, 3), "best_ms": round(best_ms, 3),
+        }))
+
+
+if __name__ == "__main__":
+    main()
