@@ -166,11 +166,14 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
             elif cfg.agg in ("krum", "Krum"):
                 flat = numpy_ref.krum(w_stack, cfg.honest_size).copy()
             elif cfg.agg == "multi_krum":
-                flat = numpy_ref.multi_krum(w_stack, cfg.honest_size)
+                flat = numpy_ref.multi_krum(w_stack, cfg.honest_size, m=cfg.krum_m)
             elif cfg.agg == "bulyan":
                 flat = numpy_ref.bulyan(w_stack, cfg.honest_size)
             elif cfg.agg == "cclip":
-                flat = numpy_ref.centered_clip(w_stack, guess=flat)
+                flat = numpy_ref.centered_clip(
+                    w_stack, guess=flat,
+                    clip_tau=cfg.clip_tau, clip_iters=cfg.clip_iters,
+                )
             else:
                 raise KeyError(f"ref backend: unknown aggregator {cfg.agg!r}")
 

@@ -34,6 +34,9 @@ ARG_TO_FIELD = {
     "sharding": ("sharded", _SHARDING.get),
     "agg_impl": ("agg_impl", None),
     "prng_impl": ("prng_impl", None),
+    "krum_m": ("krum_m", None),
+    "clip_tau": ("clip_tau", None),
+    "clip_iters": ("clip_iters", None),
     "profile_dir": ("profile_dir", None),
     "model_parallel": ("model_parallel", None),
     "rounds": ("rounds", None),
@@ -94,6 +97,12 @@ def build_parser() -> argparse.ArgumentParser:
         default="auto",
         help="Weiszfeld step implementation (pallas = fused TPU kernel)",
     )
+    p.add_argument("--krum-m", type=int, default=None,
+                   help="multi-Krum selection count (default: honest size)")
+    p.add_argument("--clip-tau", type=float, default=10.0,
+                   help="centered-clipping radius (agg=cclip)")
+    p.add_argument("--clip-iters", type=int, default=3,
+                   help="centered-clipping iterations (agg=cclip)")
     p.add_argument(
         "--prng-impl",
         choices=["threefry", "rbg", "unsafe_rbg"],

@@ -46,6 +46,11 @@ class FedConfig:
     agg_maxiter: int = 1000
     agg_tol: float = 1e-5
     gm_p_max: float = 1.0
+    # extended-aggregator knobs: multi-Krum selection count (None = honest
+    # size), centered-clipping radius and fixed iteration count
+    krum_m: Optional[int] = None
+    clip_tau: float = 10.0
+    clip_iters: int = 3
     # "auto" | "xla" | "pallas": geometric-median Weiszfeld step
     # implementation (pallas = fused single-HBM-pass TPU kernel,
     # ops/pallas_kernels.py).  "auto" resolves to pallas on a real TPU
@@ -106,6 +111,13 @@ class FedConfig:
         assert self.honest_size > 0, "honest_size must be positive"
         assert self.agg_impl in ("auto", "xla", "pallas"), (
             f"agg_impl must be 'auto', 'xla' or 'pallas', got {self.agg_impl!r}"
+        )
+        assert self.krum_m is None or 1 <= self.krum_m <= self.node_size, (
+            f"krum_m must be in [1, K={self.node_size}], got {self.krum_m}"
+        )
+        assert self.clip_tau > 0 and self.clip_iters >= 1, (
+            f"clip_tau must be > 0 and clip_iters >= 1, "
+            f"got {self.clip_tau}, {self.clip_iters}"
         )
         assert self.prng_impl in ("threefry", "rbg", "unsafe_rbg"), (
             f"prng_impl must be 'threefry', 'rbg' or 'unsafe_rbg', "

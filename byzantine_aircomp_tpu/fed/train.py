@@ -289,6 +289,9 @@ class FedTrainer:
                 tol=cfg.agg_tol,
                 p_max=cfg.gm_p_max,
                 impl=self._agg_impl,
+                m=cfg.krum_m,
+                clip_tau=cfg.clip_tau,
+                clip_iters=cfg.clip_iters,
             )
             if self._server_tx is not None:
                 # FedOpt: the aggregate defines a pseudo-gradient

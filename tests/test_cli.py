@@ -98,3 +98,13 @@ def test_end_to_end_tiny_run(tmp_path):
         "displayInterval",
     ]:
         assert key in loaded
+
+
+def test_krum_m_out_of_range_rejected():
+    import pytest
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+
+    with pytest.raises(AssertionError):
+        FedConfig(honest_size=8, byz_size=2, agg="multi_krum", krum_m=0).validate()
+    with pytest.raises(AssertionError):
+        FedConfig(honest_size=8, byz_size=2, agg="multi_krum", krum_m=11).validate()

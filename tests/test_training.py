@@ -154,3 +154,18 @@ def test_rbg_prng_stream_trains():
     b = run_short(cfg)
     np.testing.assert_allclose(a["valAccPath"], b["valAccPath"], atol=1e-6)
     assert a["valAccPath"][-1] > 0.3
+
+
+def test_cclip_knobs_reach_aggregator():
+    # a tiny clip radius must visibly slow the center's movement vs a large
+    # one — proves clip_tau flows from config into the aggregator
+    tight = run_short(make_cfg(agg="cclip", clip_tau=1e-4, rounds=1))
+    loose = run_short(make_cfg(agg="cclip", clip_tau=100.0, rounds=1))
+    assert loose["valAccPath"][-1] > tight["valAccPath"][-1] + 0.1
+
+
+def test_krum_m_reaches_aggregator():
+    a = run_short(make_cfg(agg="multi_krum", rounds=1, seed=3))
+    b = run_short(make_cfg(agg="multi_krum", krum_m=1, rounds=1, seed=3))
+    # m=1 (single lowest-score client) vs m=honest: different trajectories
+    assert not np.allclose(a["valLossPath"][-1], b["valLossPath"][-1])
