@@ -82,6 +82,11 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
             f"ref backend: unknown attack {cfg.attack!r}; known: "
             f"{sorted(_KNOWN_ATTACKS)}"
         )
+    _PARAM_ATTACKS = {"alie", "ipm", "gaussian"}  # same contract as AttackSpec
+    if cfg.attack_param is not None and cfg.attack not in _PARAM_ATTACKS:
+        raise ValueError(
+            f"attack {cfg.attack!r} takes no scalar parameter"
+        )
 
     ds = dataset if dataset is not None else data_lib.load(cfg.dataset)
     n_cls = ds.num_classes
@@ -132,11 +137,14 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
             elif cfg.attack == "signflip" and cfg.byz_size:
                 w_stack[-cfg.byz_size :] *= -1.0
             elif cfg.attack == "alie" and cfg.byz_size:
-                w_stack = numpy_ref.alie(w_stack, cfg.byz_size)
+                z = 1.5 if cfg.attack_param is None else cfg.attack_param
+                w_stack = numpy_ref.alie(w_stack, cfg.byz_size, z=z)
             elif cfg.attack == "ipm" and cfg.byz_size:
-                w_stack = numpy_ref.ipm(w_stack, cfg.byz_size)
+                eps = 0.5 if cfg.attack_param is None else cfg.attack_param
+                w_stack = numpy_ref.ipm(w_stack, cfg.byz_size, eps=eps)
             elif cfg.attack == "gaussian" and cfg.byz_size:
-                w_stack[-cfg.byz_size :] = rng.normal(
+                sigma = 1.0 if cfg.attack_param is None else cfg.attack_param
+                w_stack[-cfg.byz_size :] = sigma * rng.normal(
                     size=(cfg.byz_size, flat.size)
                 ).astype(np.float32)
 

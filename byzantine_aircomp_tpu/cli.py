@@ -34,6 +34,7 @@ ARG_TO_FIELD = {
     "sharding": ("sharded", _SHARDING.get),
     "agg_impl": ("agg_impl", None),
     "prng_impl": ("prng_impl", None),
+    "attack_param": ("attack_param", None),
     "krum_m": ("krum_m", None),
     "clip_tau": ("clip_tau", None),
     "clip_iters": ("clip_iters", None),
@@ -97,6 +98,8 @@ def build_parser() -> argparse.ArgumentParser:
         default="auto",
         help="Weiszfeld step implementation (pallas = fused TPU kernel)",
     )
+    p.add_argument("--attack-param", type=float, default=None,
+                   help="scalar attack magnitude (alie z / ipm eps / gaussian sigma)")
     p.add_argument("--krum-m", type=int, default=None,
                    help="multi-Krum selection count (default: honest size)")
     p.add_argument("--clip-tau", type=float, default=10.0,

@@ -49,6 +49,9 @@ class FedConfig:
     # extended-aggregator knobs: multi-Krum selection count (None = honest
     # size), centered-clipping radius and fixed iteration count
     krum_m: Optional[int] = None
+    # scalar magnitude for parameterized message attacks (alie z, ipm eps,
+    # gaussian sigma); None = the attack's own default
+    attack_param: Optional[float] = None
     clip_tau: float = 10.0
     clip_iters: int = 3
     # "auto" | "xla" | "pallas": geometric-median Weiszfeld step

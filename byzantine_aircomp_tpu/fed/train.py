@@ -272,7 +272,9 @@ class FedTrainer:
 
         with jax.named_scope("message_attack"):
             if self.attack is not None:
-                w_stack = self.attack.apply_message(w_stack, cfg.byz_size, k_msg)
+                w_stack = self.attack.apply_message(
+                    w_stack, cfg.byz_size, k_msg, param=cfg.attack_param
+                )
 
         with jax.named_scope("channel"):
             if cfg.noise_var is not None and agg_lib.needs_oma_prepass(cfg.agg):

@@ -53,16 +53,25 @@ class AttackSpec:
     data_fn: Optional[Callable] = None
     grad_scale: float = 1.0
     message_fn: Optional[Callable] = None
+    # name of the message_fn keyword its magnitude knob binds to (alie: z,
+    # ipm: eps, gaussian: sigma); None = the attack has no scalar knob
+    param_name: Optional[str] = None
 
     def apply_data(self, x, y, num_classes: int):
         if self.data_fn is None:
             return x, y
         return self.data_fn(x, y, num_classes)
 
-    def apply_message(self, wmatrix, byz_size: int, key=None):
+    def apply_message(self, wmatrix, byz_size: int, key=None, param=None):
+        # param compatibility is checked BEFORE the no-op returns so a knob
+        # set on a knob-less attack fails loudly even when the message pass
+        # would be a no-op (data-level attack, or byz_size == 0)
+        if param is not None and self.param_name is None:
+            raise ValueError(f"attack {self.name!r} takes no scalar parameter")
         if self.message_fn is None or byz_size == 0:
             return wmatrix
-        return self.message_fn(wmatrix, byz_size, key)
+        kw = {self.param_name: param} if param is not None else {}
+        return self.message_fn(wmatrix, byz_size, key, **kw)
 
 
 def _classflip_data(x, y, num_classes):
@@ -128,9 +137,15 @@ ATTACKS.register("weightflip")(
 )
 ATTACKS.register("signflip")(AttackSpec("signflip", message_fn=_signflip_message))
 ATTACKS.register("gradascent")(AttackSpec("gradascent", grad_scale=-1.0))
-ATTACKS.register("alie")(AttackSpec("alie", message_fn=_alie_message))
-ATTACKS.register("ipm")(AttackSpec("ipm", message_fn=_ipm_message))
-ATTACKS.register("gaussian")(AttackSpec("gaussian", message_fn=_gaussian_message))
+ATTACKS.register("alie")(
+    AttackSpec("alie", message_fn=_alie_message, param_name="z")
+)
+ATTACKS.register("ipm")(
+    AttackSpec("ipm", message_fn=_ipm_message, param_name="eps")
+)
+ATTACKS.register("gaussian")(
+    AttackSpec("gaussian", message_fn=_gaussian_message, param_name="sigma")
+)
 
 
 def resolve(name: Optional[str]) -> Optional[AttackSpec]:

@@ -117,3 +117,22 @@ def test_alie_ipm_oracles_match_jax_attacks():
         spec = attacks.resolve(name)
         got = np.asarray(spec.apply_message(jnp.asarray(w), 3))
         np.testing.assert_allclose(got, oracle(w, 3), rtol=1e-5, atol=1e-6)
+
+
+def test_attack_param_scales_alie_and_ipm():
+    rng = np.random.default_rng(14)
+    w = rng.normal(size=(10, 21)).astype(np.float32)
+    alie = attacks.resolve("alie")
+    # z=0 -> Byzantine rows sit exactly at the honest mean
+    out = np.asarray(alie.apply_message(jnp.asarray(w), 3, param=0.0))
+    mu = w[:7].mean(0)
+    for r in range(7, 10):
+        np.testing.assert_allclose(out[r], mu, rtol=1e-5, atol=1e-6)
+    ipm = attacks.resolve("ipm")
+    out2 = np.asarray(ipm.apply_message(jnp.asarray(w), 3, param=2.0))
+    np.testing.assert_allclose(out2[-1], -2.0 * w[:7].mean(0), rtol=1e-5, atol=1e-6)
+    # attacks without a scalar knob reject a param loudly
+    import pytest
+
+    with pytest.raises(ValueError):
+        attacks.resolve("weightflip").apply_message(jnp.asarray(w), 3, param=1.0)

@@ -69,3 +69,25 @@ def test_ref_backend_new_attack_and_agg_branches():
         )
         paths = run_ref(cfg, log_fn=lambda s: None, dataset=ds)
         assert np.isfinite(paths["valLossPath"]).all(), (attack, agg)
+
+
+def test_ref_backend_attack_param_forwarded():
+    from byzantine_aircomp_tpu.backends.ref_trainer import run_ref
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+    import pytest
+
+    ds = data_lib.load("mnist", synthetic_train=600, synthetic_val=200)
+    # agg=mean: the mean shifts linearly with z (median would be exactly
+    # invariant to the outliers' distance — its robustness property)
+    kw = dict(honest_size=17, byz_size=3, attack="alie", agg="mean",
+              rounds=1, display_interval=2, batch_size=16, eval_train=False)
+    a = run_ref(FedConfig(**kw), log_fn=lambda s: None, dataset=ds)
+    b = run_ref(FedConfig(**kw, attack_param=50.0), log_fn=lambda s: None, dataset=ds)
+    # a huge z must visibly change the trajectory vs the default
+    assert a["valLossPath"][-1] != b["valLossPath"][-1]
+    with pytest.raises(ValueError):
+        run_ref(
+            FedConfig(**{**kw, "attack": "weightflip"}, attack_param=1.0),
+            log_fn=lambda s: None, dataset=ds,
+        )
