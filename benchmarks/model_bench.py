@@ -1,0 +1,152 @@
+"""End-to-end rounds/sec for the BASELINE.json scale-up rungs beyond the
+MNIST MLP headline that ``bench.py`` records.
+
+    python benchmarks/model_bench.py                 # default rung set
+    python benchmarks/model_bench.py --preset emnist_cnn_k200_b40_classflip
+    python benchmarks/model_bench.py --timed-rounds 20
+
+Prints one JSON line per config: ``{"metric": ..., "value": rounds/sec,
+"unit": "rounds/sec", ...}``.  Methodology follows bench.py /
+docs/PERFORMANCE.md: one ``run_rounds`` device program per timed block, the
+block compiled and executed twice during warmup, and a host transfer of a
+params-derived scalar as the completion barrier (``block_until_ready`` can
+return early on tunneled devices).
+
+The K=1000 ResNet-18 presets need the [K, d=11.2M] stack sharded over a
+multi-chip mesh (~45 GB, see presets.py); on a single chip this bench runs
+the same model/attack/aggregator rung scaled to K=100 so the number is
+measurable anywhere.  Pass ``--preset`` explicitly to bench the full-size
+configs on a mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# (preset, FedConfig overrides) — the default rung set, sized to fit one chip
+DEFAULT_RUNGS = [
+    ("emnist_cnn_k200_b40_classflip", {}),
+    # full-size K=1000 is the multi-chip regime; K=100 B=10 keeps the same
+    # Byzantine fraction and fits the [K, d] stack (~4.5 GB) on one chip
+    (
+        "cifar10_resnet18_k1000_b100_signflip_krum",
+        {"honest_size": 90, "byz_size": 10},
+    ),
+]
+
+
+def bench_config(preset: str, overrides: dict, warmup: int, timed: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from byzantine_aircomp_tpu import presets
+    from byzantine_aircomp_tpu.fed.harness import _make_trainer
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+
+    cfg = presets.get(
+        preset,
+        rounds=warmup + 3 * timed,
+        eval_train=False,
+        **overrides,
+    )
+    trainer = _make_trainer(cfg, FedTrainer)
+    k = cfg.node_size
+    log(
+        f"bench[{preset}]: model={cfg.model} dataset={trainer.dataset.name}/"
+        f"{trainer.dataset.source} K={k} B={cfg.byz_size} agg={cfg.agg} "
+        f"attack={cfg.attack} d={trainer.dim}"
+    )
+
+    # warmup: compile the timed-shape program, then run it once more —
+    # the first post-compile execution runs below steady state
+    trainer.run_rounds(0, warmup)
+    trainer.run_rounds(warmup, timed)
+    trainer.run_rounds(warmup + timed, timed)
+    float(jnp.sum(trainer.flat_params))  # honest completion barrier
+
+    start = warmup + 2 * timed
+    t0 = time.perf_counter()
+    trainer.run_rounds(start, timed)
+    float(jnp.sum(trainer.flat_params))
+    dt = time.perf_counter() - t0
+    rps = timed / dt
+
+    loss, acc = trainer.evaluate("val")
+    log(
+        f"bench[{preset}]: {timed} rounds in {dt:.3f}s -> {rps:.2f} rounds/sec"
+        f" (val_loss={loss:.4f} val_acc={acc:.4f})"
+    )
+    return {
+        "metric": f"fl_rounds_per_sec_{preset}"
+        + (f"_K{k}" if overrides else ""),
+        "value": round(rps, 3),
+        "unit": "rounds/sec",
+        "val_acc": round(acc, 4),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--preset",
+        action="append",
+        default=None,
+        help="preset name (repeatable); default: the single-chip rung set",
+    )
+    ap.add_argument("--warmup-rounds", type=int, default=2)
+    ap.add_argument("--timed-rounds", type=int, default=10)
+    args = ap.parse_args()
+
+    # same wedged-tunnel watchdog idea as bench.py: abort instead of
+    # hanging.  The timer is restarted PER RUNG (and cancelled at the end,
+    # as bench.py does) so a multi-rung run gets the full budget for each
+    # config rather than one shared deadline that kills a legitimately
+    # slow later rung mid-benchmark.
+    deadline = float(os.environ.get("BENCH_WATCHDOG_SECS", "1800"))
+    watchdog: threading.Timer | None = None
+
+    def _abort():
+        log(f"model_bench: WATCHDOG — no completion after {deadline:.0f}s")
+        os._exit(3)
+
+    def _rearm():
+        nonlocal watchdog
+        if watchdog is not None:
+            watchdog.cancel()
+        if deadline > 0:
+            watchdog = threading.Timer(deadline, _abort)
+            watchdog.daemon = True
+            watchdog.start()
+
+    _rearm()  # covers backend init, which hangs first on a wedged tunnel
+    import jax
+
+    log(
+        f"model_bench: backend={jax.default_backend()} "
+        f"devices={len(jax.devices())}"
+    )
+    rungs = (
+        [(p, {}) for p in args.preset] if args.preset else DEFAULT_RUNGS
+    )
+    for preset, overrides in rungs:
+        _rearm()
+        result = bench_config(
+            preset, overrides, args.warmup_rounds, args.timed_rounds
+        )
+        print(json.dumps(result), flush=True)
+    if watchdog is not None:
+        watchdog.cancel()
+
+
+if __name__ == "__main__":
+    main()

@@ -82,12 +82,22 @@ def pairwise_sq_dists(wmatrix: jnp.ndarray) -> jnp.ndarray:
 
     ||w_i - w_j||^2 = ||w_i||^2 + ||w_j||^2 - 2 <w_i, w_j>; one MXU matmul
     instead of the reference's [K, K, d] broadcast (``:199``).  Clamped at 0
-    against float cancellation.
+    against float cancellation.  Non-finite rows (e.g. an overflowed gaussian
+    attack) produce Inf - Inf = NaN in the Gram form; those distances are
+    mapped to +Inf and the diagonal is forced to its exact value 0, so a
+    poisoned row scores Inf instead of NaN and can never win the selection.
     """
     sq = jnp.sum(wmatrix * wmatrix, axis=1)
     gram = jnp.dot(wmatrix, wmatrix.T, preferred_element_type=jnp.float32)
     dist = sq[:, None] + sq[None, :] - 2.0 * gram
-    return jnp.maximum(dist, 0.0)
+    # a NaN distance can only come from a non-finite row (Inf - Inf in the
+    # Gram form); "infinitely far" is the right semantics — NaN would sort
+    # as the SMALLEST distance under top_k(-dist) and as the BEST score
+    # under top_k(-scores), making Krum select the poisoned row
+    dist = jnp.where(jnp.isnan(dist), jnp.inf, dist)
+    dist = jnp.maximum(dist, 0.0)
+    k = wmatrix.shape[0]
+    return jnp.where(jnp.eye(k, dtype=bool), 0.0, dist)
 
 
 def krum_scores(wmatrix: jnp.ndarray, honest_size: int) -> jnp.ndarray:
@@ -126,11 +136,24 @@ def multi_krum(
     Not present in the reference (it ships single-Krum only, ``:197-204``);
     included per the scale-up configs in BASELINE.json.  Default
     m = honest_size.
+
+    The mean is taken as a [K]-weight matvec (1/m on the selected rows)
+    instead of ``mean(wmatrix[idx])``: the gather would materialize an
+    [m, d] copy — ~40 GB at the ResNet-18 rung (m=900, d=11.2M, f32) —
+    while the matvec reads the stack once and writes only [d].
     """
     m_sel = honest_size if m is None else int(m)
     scores = krum_scores(wmatrix, honest_size)
     _, idx = jax.lax.top_k(-scores, m_sel)
-    return jnp.mean(wmatrix[idx], axis=0)
+    k, d = wmatrix.shape
+    if k * d <= _DENSE_MAX_ELEMS:
+        return selected_rows_mean(wmatrix, idx, m_sel)
+    # large-d regime: the where-select inside the contraction would
+    # materialize a [K, d] temp if XLA does not fuse it into the dot —
+    # bound peak extra memory at O(K * block) instead
+    return _blocked_columns(
+        wmatrix, lambda cols: selected_rows_mean(cols, idx, m_sel)
+    )
 
 
 @AGGREGATORS.register("cclip")
@@ -180,13 +203,16 @@ def bulyan(
     beta both nonempty; B = K - honest_size), checked statically at trace
     time.
     """
-    k = wmatrix.shape[0]
+    k, d = wmatrix.shape
     b = k - honest_size
     theta, beta = bulyan_sizes(k, b)
     scores = krum_scores(wmatrix, honest_size)
     _, idx = jax.lax.top_k(-scores, theta)
-    sel = wmatrix[idx]  # [theta, d]
-    return bulyan_tail(sel, beta)
+    if theta * d <= _DENSE_MAX_ELEMS:
+        return bulyan_tail(wmatrix[idx], beta)
+    # large-d regime (ResNet-18: theta*d is tens of GB): never materialize
+    # the [theta, d] selection — gather + tail per column block under a scan
+    return _blocked_columns(wmatrix, lambda cols: bulyan_tail(cols[idx], beta))
 
 
 def bulyan_sizes(k: int, b: int):
@@ -200,6 +226,60 @@ def bulyan_sizes(k: int, b: int):
             f"(K={k}, B={b} -> theta={theta}, beta={beta})"
         )
     return theta, beta
+
+
+# one-shot budget for the dense selection paths, in elements of the largest
+# temporary the op would materialize: bulyan gates on theta*d (the [theta, d]
+# selection plus its same-sized distance transpose and [d, beta] top_k
+# outputs), multi_krum on k*d (the masked stack feeding the contraction, in
+# case XLA does not fuse the where into the dot).  At 1<<25 both stay a few
+# hundred MB.  Above it (the K=100+ ResNet-18 regime, where the stack alone
+# is multiple GB) the blocked column path bounds peak extra memory at
+# O(K * block).
+_DENSE_MAX_ELEMS = 1 << 25
+
+
+def selected_rows_mean(
+    wmatrix: jnp.ndarray, idx: jnp.ndarray, m_sel: int
+) -> jnp.ndarray:
+    """Mean of the ``idx`` rows as a [K]-weight matvec (1/m on selected
+    rows), with the unpicked rows selected (not multiplied) to 0 first so a
+    rejected row containing Inf cannot poison the sum as 0*Inf = NaN.
+
+    GSPMD-friendly — the ring collectives share this helper so the dense and
+    sharded selection semantics cannot drift.  ``m_sel=1`` with a length-1
+    ``idx`` extracts a single row (the single-Krum winner) without the
+    dynamic ``wmatrix[argmin]`` gather that makes GSPMD all-gather the
+    whole stack."""
+    weights = jnp.zeros(wmatrix.shape[0], wmatrix.dtype).at[idx].set(1.0 / m_sel)
+    masked = jnp.where(weights[:, None] > 0, wmatrix, 0.0)
+    return jnp.dot(weights, masked, preferred_element_type=jnp.float32)
+
+
+def _blocked_columns(wmatrix: jnp.ndarray, fn, max_block_elems: int = 1 << 26):
+    """Apply a columnwise reduction ``fn([K, block] cols) -> [block]`` over
+    column blocks of the [K, d] stack under a scan, concatenating the
+    results to [d]: peak extra memory O(K * block) instead of whatever
+    temporaries ``fn`` would materialize at full d.  The remainder columns
+    (d % block) are processed with one static slice so no padded copy of
+    the stack is made."""
+    k, d = wmatrix.shape
+    block = max(128, (min(d, max_block_elems // k) // 128) * 128)
+    n_blocks, rem = divmod(d, block)
+
+    def step(_, i):
+        cols = jax.lax.dynamic_slice_in_dim(wmatrix, i * block, block, axis=1)
+        return _, fn(cols)
+
+    parts = []
+    if n_blocks:
+        _, out = jax.lax.scan(
+            step, None, jnp.arange(n_blocks, dtype=jnp.int32)
+        )
+        parts.append(out.reshape(-1))
+    if rem:
+        parts.append(fn(wmatrix[:, d - rem :]))
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
 
 def bulyan_tail(sel: jnp.ndarray, beta: int) -> jnp.ndarray:

@@ -170,8 +170,19 @@ def ring_krum_scores(
             0, p - 1, body, (w, my_sq, rows0)
         )
         rows = accumulate(rows, blk, blk_sq, p - 1)
-        # complete the d-sharded inner products, then clamp float cancellation
-        dist = jnp.maximum(jax.lax.psum(rows, MODEL_AXIS), 0.0)
+        # complete the d-sharded inner products, then apply the same
+        # non-finite-row guards as ops.aggregators.pairwise_sq_dists: the
+        # Gram form turns Inf rows into NaN distances (Inf - Inf), and a
+        # NaN score sorts as BEST under top_k(-scores) — selecting the
+        # poisoned row.  NaN -> +Inf (infinitely far), clamp cancellation,
+        # and force self-distances to their exact value 0.
+        dist = jax.lax.psum(rows, MODEL_AXIS)
+        dist = jnp.where(jnp.isnan(dist), jnp.inf, dist)
+        dist = jnp.maximum(dist, 0.0)
+        self_col = me * k_loc + jnp.arange(k_loc)
+        dist = jnp.where(
+            jnp.arange(k_total)[None, :] == self_col[:, None], 0.0, dist
+        )
         neg_top, _ = jax.lax.top_k(-dist, k_sel)
         return -jnp.sum(neg_top, axis=1)  # [k_loc]
 
@@ -189,10 +200,10 @@ def ring_krum(mesh: Mesh, w_stack: jnp.ndarray, *, honest_size: int, **_):
     The winning row is extracted as a one-hot-weighted column sum rather
     than ``w_stack[argmin]``: a dynamic row index makes GSPMD all-gather
     the ENTIRE [K, d] stack onto every device before slicing (verified in
-    HLO), while the one-hot contraction partitions into per-shard psums."""
+    HLO), while the masked contraction partitions into per-shard psums and
+    keeps rejected Inf rows out of the sum (0*Inf = NaN otherwise)."""
     scores = ring_krum_scores(mesh, w_stack, honest_size)
-    sel = jax.nn.one_hot(jnp.argmin(scores), w_stack.shape[0], dtype=w_stack.dtype)
-    return jnp.sum(w_stack * sel[:, None], axis=0)
+    return agg_ops.selected_rows_mean(w_stack, jnp.argmin(scores)[None], 1)
 
 
 def ring_multi_krum(
@@ -203,11 +214,16 @@ def ring_multi_krum(
     m: Optional[int] = None,
     **_,
 ):
-    """Multi-Krum on the sharded stack: mean of the m lowest-scoring rows."""
+    """Multi-Krum on the sharded stack: mean of the m lowest-scoring rows.
+
+    Averaged via the shared masked [K]-weight contraction
+    (:func:`..ops.aggregators.selected_rows_mean`): a dynamic
+    ``w_stack[idx]`` gather makes GSPMD all-gather the whole [K, d] stack,
+    while the matvec partitions into per-shard psums."""
     m_sel = honest_size if m is None else int(m)
     scores = ring_krum_scores(mesh, w_stack, honest_size)
     _, idx = jax.lax.top_k(-scores, m_sel)
-    return jnp.mean(w_stack[idx], axis=0)
+    return agg_ops.selected_rows_mean(w_stack, idx, m_sel)
 
 
 def ring_bulyan(
@@ -228,5 +244,10 @@ def ring_bulyan(
     scores = ring_krum_scores(mesh, w_stack, honest_size)
     _, idx = jax.lax.top_k(-scores, theta)
     sel_mat = jax.nn.one_hot(idx, k, dtype=w_stack.dtype)  # [theta, K]
-    sel = jnp.dot(sel_mat, w_stack, preferred_element_type=jnp.float32)
+    # select (not multiply) unpicked rows to 0 before the contraction: a
+    # Krum-rejected row containing Inf would otherwise contribute
+    # 0*Inf = NaN to every selected row
+    picked = jnp.sum(sel_mat, axis=0) > 0  # [K]
+    masked = jnp.where(picked[:, None], w_stack, 0.0)
+    sel = jnp.dot(sel_mat, masked, preferred_element_type=jnp.float32)
     return agg_ops.bulyan_tail(sel, beta)

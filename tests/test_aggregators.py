@@ -236,3 +236,78 @@ def test_centered_clip_bounds_outlier_influence():
     assert np.linalg.norm(out - honest.mean(0)) < 3 * 1.0 / 20 + 0.05
     # the plain mean is destroyed
     assert np.linalg.norm(w.mean(0) - honest.mean(0)) > 1e6
+
+
+def test_bulyan_blocked_tail_matches_dense():
+    # the large-d blocked path (scan over column blocks + remainder slice)
+    # must agree exactly with the dense one-shot tail; block=128 min and a
+    # d chosen to force multiple blocks plus a non-empty remainder
+    rng = np.random.default_rng(7)
+    k, d = 25, 300  # max_block_elems=3200 -> block=128, 2 blocks + rem 44
+    w = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32))
+    honest = 20
+    theta, beta = agg.bulyan_sizes(k, k - honest)
+    scores = agg.krum_scores(w, honest)
+    _, idx = jax.lax.top_k(-scores, theta)
+    dense = agg.bulyan_tail(w[idx], beta)
+    blocked = agg._blocked_columns(
+        w, lambda cols: agg.bulyan_tail(cols[idx], beta), max_block_elems=3200
+    )
+    np.testing.assert_allclose(np.asarray(blocked), np.asarray(dense), rtol=0, atol=0)
+
+
+def test_bulyan_blocked_engages_above_budget(monkeypatch):
+    # shrink the dense budget so the public entry point routes through the
+    # blocked tail, and check it still matches the numpy oracle
+    rng = np.random.default_rng(11)
+    w = rng.normal(size=(25, 211)).astype(np.float32)
+    monkeypatch.setattr(agg, "_DENSE_MAX_ELEMS", 64)
+    got = np.asarray(agg.bulyan(jnp.asarray(w), honest_size=20))
+    want = numpy_ref.bulyan(w, honest_size=20)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_krum_ignores_inf_in_rejected_rows():
+    # a Krum-rejected Byzantine row containing Inf must not leak into the
+    # average (the weight contraction would turn 0*Inf into NaN without the
+    # row mask).  The Inf coordinate sits where every honest row is strictly
+    # negative so the Gram-form distances come out +Inf (not NaN) and the
+    # selection stays well-defined in both implementations.
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(12, 6)).astype(np.float32)
+    w[:, 0] = -1.0 - np.abs(w[:, 0])  # strictly negative column
+    w[-1, 0] = np.inf
+    got = np.asarray(agg.multi_krum(jnp.asarray(w), honest_size=10, m=5))
+    assert np.isfinite(got).all()
+    want = numpy_ref.multi_krum(w, honest_size=10, m=5)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_krum_scores_inf_row_any_sign_alignment():
+    # regardless of the sign structure of the honest column the Inf row
+    # lands on, cross-row NaN distances (Inf - Inf in the Gram form) must be
+    # mapped to +Inf: honest scores stay finite, the poisoned row scores
+    # Inf, and neither krum nor multi_krum can select it
+    rng = np.random.default_rng(5)
+    for col_sign in (1.0, -1.0):
+        w = rng.normal(size=(12, 6)).astype(np.float32)
+        w[:, 0] = col_sign * (1.0 + np.abs(w[:, 0]))
+        w[-1, 0] = np.inf
+        scores = np.asarray(agg.krum_scores(jnp.asarray(w), 10))
+        assert np.isfinite(scores[:-1]).all(), col_sign
+        assert np.isinf(scores[-1]) and not np.isnan(scores[-1])
+        got = np.asarray(agg.krum(jnp.asarray(w), honest_size=10))
+        assert np.isfinite(got).all()
+        got_m = np.asarray(agg.multi_krum(jnp.asarray(w), honest_size=10, m=5))
+        assert np.isfinite(got_m).all()
+
+
+def test_multi_krum_blocked_path_matches_oracle(monkeypatch):
+    # shrink the dense budget so multi_krum routes through the blocked
+    # column contraction and check it still matches the numpy oracle
+    rng = np.random.default_rng(13)
+    w = rng.normal(size=(12, 211)).astype(np.float32)
+    monkeypatch.setattr(agg, "_DENSE_MAX_ELEMS", 64)
+    got = np.asarray(agg.multi_krum(jnp.asarray(w), honest_size=9, m=5))
+    want = numpy_ref.multi_krum(w, honest_size=9, m=5)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -180,3 +180,40 @@ def test_ring_bulyan_matches_dense():
     got = collective.ring_bulyan(m, w, honest_size=14)
     want = agg_lib.bulyan(w, honest_size=14)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_ring_krum_scores_inf_row_matches_dense():
+    # an overflowed (Inf) Byzantine row must yield an Inf score — not a NaN
+    # that top_k(-scores) would sort as BEST — in both formulations; the Inf
+    # coordinate sits on a strictly-negative column so cross-row distances
+    # are +Inf, not NaN, in the Gram form (see pairwise_sq_dists)
+    m = mesh_lib.make_mesh(model_parallel=2)
+    w = jax.random.normal(jax.random.PRNGKey(7), (16, 256))
+    w = w.at[:, 0].set(-1.0 - jnp.abs(w[:, 0]))
+    w = w.at[-1, 0].set(jnp.inf)
+    got = np.asarray(collective.ring_krum_scores(m, w, honest_size=13))
+    want = np.asarray(agg_lib.krum_scores(w, honest_size=13))
+    assert np.isinf(want[-1]) and not np.isnan(want[-1])
+    assert np.isinf(got[-1]) and not np.isnan(got[-1])
+    np.testing.assert_allclose(got[:-1], want[:-1], rtol=1e-3, atol=1e-3)
+
+
+def test_ring_krum_and_bulyan_survive_inf_row():
+    # a rejected Inf row must not reach the output through the one-hot
+    # extractions (0*Inf = NaN without the row masks), for either sign
+    # alignment of the poisoned column
+    m = mesh_lib.make_mesh(model_parallel=2)
+    for col_sign in (1.0, -1.0):
+        w = jax.random.normal(jax.random.PRNGKey(8), (16, 256))
+        w = w.at[:, 0].set(col_sign * (1.0 + jnp.abs(w[:, 0])))
+        w = w.at[-1, 0].set(jnp.inf)
+        got = np.asarray(collective.ring_krum(m, w, honest_size=13))
+        assert np.isfinite(got).all(), col_sign
+        got_mk = np.asarray(
+            collective.ring_multi_krum(m, w, honest_size=13, m=10)
+        )
+        assert np.isfinite(got_mk).all(), col_sign
+        got_b = np.asarray(collective.ring_bulyan(m, w, honest_size=13))
+        assert np.isfinite(got_b).all(), col_sign
+        want_b = np.asarray(agg_lib.bulyan(w, honest_size=13))
+        np.testing.assert_allclose(got_b, want_b, rtol=1e-4, atol=1e-5)
