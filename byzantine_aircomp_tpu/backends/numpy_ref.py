@@ -155,6 +155,35 @@ def bulyan(w: np.ndarray, honest_size: int) -> np.ndarray:
     return out
 
 
+def sign_majority_vote(
+    w: np.ndarray,
+    guess: np.ndarray,
+    noise_var: Optional[float] = None,
+    sign_eta: Optional[float] = None,
+    rng: Optional[np.random.Generator] = None,
+) -> np.ndarray:
+    """Oracle for the framework's one-bit OTA aggregator (an extension):
+    new = guess + eta * sign(sum_i sign(w_i - guess) + n), eta = sign_eta or
+    the coordinatewise LOWER-MIDDLE median of |w_i - guess| (torch
+    order-statistic semantics, matching the jax path).  Non-finite deltas
+    cast a 0 ballot and count as Inf for the eta median, as in the jax
+    path."""
+    delta = w - guess[None, :]
+    finite = np.isfinite(delta)
+    votes = np.where(finite, np.sign(delta), 0.0).sum(axis=0)
+    if noise_var is not None:
+        assert rng is not None
+        votes = votes + rng.normal(
+            0.0, np.sqrt(noise_var / 2.0), votes.shape
+        )
+    if sign_eta is None:
+        absd = np.where(finite, np.abs(delta), np.inf)
+        eta = np.sort(absd, axis=0)[(len(w) - 1) // 2]
+    else:
+        eta = np.float32(sign_eta)
+    return (guess + eta * np.sign(votes)).astype(np.float32)
+
+
 def centered_clip(
     w: np.ndarray,
     guess: Optional[np.ndarray] = None,

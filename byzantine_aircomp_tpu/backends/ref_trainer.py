@@ -148,7 +148,9 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
                     size=(cfg.byz_size, flat.size)
                 ).astype(np.float32)
 
-            if cfg.noise_var is not None and cfg.agg != "gm":
+            # channel-dispatch rule (mirrors ops.aggregators.needs_oma_prepass):
+            # gm and signmv run their own over-the-air transmission
+            if cfg.noise_var is not None and cfg.agg not in ("gm", "signmv"):
                 w_stack = numpy_ref.oma(rng, w_stack, cfg.noise_var)
 
             if cfg.agg == "gm":
@@ -181,6 +183,11 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
                 flat = numpy_ref.centered_clip(
                     w_stack, guess=flat,
                     clip_tau=cfg.clip_tau, clip_iters=cfg.clip_iters,
+                )
+            elif cfg.agg == "signmv":
+                flat = numpy_ref.sign_majority_vote(
+                    w_stack, guess=flat, noise_var=cfg.noise_var,
+                    sign_eta=cfg.sign_eta, rng=rng,
                 )
             else:
                 raise KeyError(f"ref backend: unknown aggregator {cfg.agg!r}")

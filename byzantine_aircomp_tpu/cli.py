@@ -38,6 +38,7 @@ ARG_TO_FIELD = {
     "krum_m": ("krum_m", None),
     "clip_tau": ("clip_tau", None),
     "clip_iters": ("clip_iters", None),
+    "sign_eta": ("sign_eta", None),
     "profile_dir": ("profile_dir", None),
     "model_parallel": ("model_parallel", None),
     "rounds": ("rounds", None),
@@ -106,6 +107,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="centered-clipping radius (agg=cclip)")
     p.add_argument("--clip-iters", type=int, default=3,
                    help="centered-clipping iterations (agg=cclip)")
+    p.add_argument("--sign-eta", type=float, default=None,
+                   help="one-bit OTA majority-vote step size (agg=signmv; "
+                        "default: coordinatewise median delta magnitude)")
     p.add_argument(
         "--prng-impl",
         choices=["threefry", "rbg", "unsafe_rbg"],

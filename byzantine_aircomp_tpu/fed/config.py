@@ -54,6 +54,9 @@ class FedConfig:
     attack_param: Optional[float] = None
     clip_tau: float = 10.0
     clip_iters: int = 3
+    # signmv (one-bit OTA majority vote) step magnitude; None = the
+    # coordinatewise median of |w_i - guess| (robust adaptive scale)
+    sign_eta: Optional[float] = None
     # "auto" | "xla" | "pallas": geometric-median Weiszfeld step
     # implementation (pallas = fused single-HBM-pass TPU kernel,
     # ops/pallas_kernels.py).  "auto" resolves to pallas on a real TPU
@@ -121,6 +124,9 @@ class FedConfig:
         assert self.clip_tau > 0 and self.clip_iters >= 1, (
             f"clip_tau must be > 0 and clip_iters >= 1, "
             f"got {self.clip_tau}, {self.clip_iters}"
+        )
+        assert self.sign_eta is None or self.sign_eta > 0, (
+            f"sign_eta must be positive when set, got {self.sign_eta}"
         )
         assert self.prng_impl in ("threefry", "rbg", "unsafe_rbg"), (
             f"prng_impl must be 'threefry', 'rbg' or 'unsafe_rbg', "

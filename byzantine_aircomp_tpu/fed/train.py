@@ -294,6 +294,7 @@ class FedTrainer:
                 m=cfg.krum_m,
                 clip_tau=cfg.clip_tau,
                 clip_iters=cfg.clip_iters,
+                sign_eta=cfg.sign_eta,
             )
             if self._server_tx is not None:
                 # FedOpt: the aggregate defines a pseudo-gradient

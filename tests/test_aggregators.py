@@ -150,6 +150,9 @@ def test_gm_jits_and_is_finite(wmat):
 
 def test_channel_dispatch_rule():
     assert not agg.needs_oma_prepass("gm")
+    # signmv (beyond-reference) also owns its channel: noise lands on the
+    # over-the-air vote sum, not on pre-sign weights
+    assert not agg.needs_oma_prepass("signmv")
     for name in ["gm2", "mean", "median", "trimmed_mean", "krum"]:
         assert agg.needs_oma_prepass(name)
 
@@ -311,3 +314,95 @@ def test_multi_krum_blocked_path_matches_oracle(monkeypatch):
     got = np.asarray(agg.multi_krum(jnp.asarray(w), honest_size=9, m=5))
     want = numpy_ref.multi_krum(w, honest_size=9, m=5)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_signmv_matches_oracle(wmat):
+    guess = wmat.mean(axis=0)
+    got = np.asarray(
+        agg.sign_majority_vote(jnp.asarray(wmat), guess=jnp.asarray(guess))
+    )
+    want = numpy_ref.sign_majority_vote(wmat, guess=guess)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # explicit step size
+    got_e = np.asarray(
+        agg.sign_majority_vote(
+            jnp.asarray(wmat), guess=jnp.asarray(guess), sign_eta=0.5
+        )
+    )
+    want_e = numpy_ref.sign_majority_vote(wmat, guess=guess, sign_eta=0.5)
+    np.testing.assert_allclose(got_e, want_e, rtol=1e-5, atol=1e-6)
+
+
+def test_signmv_bounded_influence_and_majority():
+    # honest clients all vote +1 on every coordinate (delta > 0); B < K/2
+    # Byzantine rows with arbitrarily huge NEGATIVE deltas can neither flip
+    # the vote (majority margin) nor inflate the step (eta is a median)
+    rng = np.random.default_rng(17)
+    guess = np.zeros(33, np.float32)
+    honest = np.abs(rng.normal(size=(15, 33))).astype(np.float32) * 0.01 + 1e-4
+    byz = np.full((6, 33), -1e8, np.float32)
+    w = jnp.asarray(np.concatenate([honest, byz]))
+    out = np.asarray(
+        agg.sign_majority_vote(w, guess=jnp.asarray(guess))
+    )
+    step = out - guess
+    assert (step > 0).all()  # honest majority direction wins
+    # eta = median |delta| over 21 rows: 15 honest small vs 6 huge -> small
+    assert np.abs(step).max() <= np.abs(honest).max() + 1e-6
+
+
+def test_signmv_noise_on_votes_is_deterministic():
+    rng = np.random.default_rng(19)
+    w = jnp.asarray(rng.normal(size=(10, 8)).astype(np.float32))
+    guess = jnp.zeros(8)
+    key = jax.random.PRNGKey(2)
+    a = np.asarray(
+        agg.sign_majority_vote(w, guess=guess, key=key, noise_var=1e-2)
+    )
+    b = np.asarray(
+        agg.sign_majority_vote(w, guess=guess, key=key, noise_var=1e-2)
+    )
+    np.testing.assert_array_equal(a, b)
+    assert np.isfinite(a).all()
+
+
+def test_signmv_survives_nan_and_inf_rows():
+    # non-finite Byzantine rows cast 0 ballots and cannot poison the vote
+    # sum or the median step magnitude
+    rng = np.random.default_rng(23)
+    guess = np.zeros(16, np.float32)
+    honest = np.abs(rng.normal(size=(9, 16))).astype(np.float32) * 0.01 + 1e-4
+    byz = np.stack([np.full(16, np.nan), np.full(16, np.inf),
+                    np.full(16, -np.inf)]).astype(np.float32)
+    w = np.concatenate([honest, byz])
+    got = np.asarray(
+        agg.sign_majority_vote(jnp.asarray(w), guess=jnp.asarray(guess))
+    )
+    assert np.isfinite(got).all()
+    assert (got > 0).all()  # honest +1 majority carries every coordinate
+    want = numpy_ref.sign_majority_vote(w, guess=guess)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_signmv_blocked_path_matches_dense(monkeypatch):
+    rng = np.random.default_rng(29)
+    w = rng.normal(size=(10, 211)).astype(np.float32)
+    guess = rng.normal(size=211).astype(np.float32)
+    dense = np.asarray(
+        agg.sign_majority_vote(jnp.asarray(w), guess=jnp.asarray(guess))
+    )
+    monkeypatch.setattr(agg, "_DENSE_MAX_ELEMS", 64)
+    blocked = np.asarray(
+        agg.sign_majority_vote(jnp.asarray(w), guess=jnp.asarray(guess))
+    )
+    np.testing.assert_array_equal(dense, blocked)
+    # the noisy path must also agree: noise is drawn [d] once, outside the
+    # column blocking, so dense and blocked consume the same stream
+    key = jax.random.PRNGKey(5)
+    monkeypatch.setattr(agg, "_DENSE_MAX_ELEMS", 1 << 25)
+    dn = np.asarray(agg.sign_majority_vote(
+        jnp.asarray(w), guess=jnp.asarray(guess), key=key, noise_var=1e-2))
+    monkeypatch.setattr(agg, "_DENSE_MAX_ELEMS", 64)
+    bn = np.asarray(agg.sign_majority_vote(
+        jnp.asarray(w), guess=jnp.asarray(guess), key=key, noise_var=1e-2))
+    np.testing.assert_array_equal(dn, bn)

@@ -38,6 +38,7 @@ def _cfg(**kw):
         dict(agg="trimmed_mean"),
         dict(honest_size=7, byz_size=3, attack="classflip", agg="gm2"),
         dict(honest_size=7, byz_size=3, attack="weightflip", agg="median"),
+        dict(honest_size=7, byz_size=3, attack="signflip", agg="signmv"),
     ],
 )
 def test_backend_parity(kw):
