@@ -216,3 +216,58 @@ def ipm(w: np.ndarray, byz_size: int, eps: float = 0.5) -> np.ndarray:
     out = w.copy()
     out[-byz_size:] = -eps * w[:-byz_size].mean(axis=0)
     return out
+
+
+def _agr_row(honest: np.ndarray, predicate, iters: int = 25) -> np.ndarray:
+    """Oracle bisection for the AGR-agnostic attacks (minmax/minsum): the
+    malicious row mu + gamma * p, p = -mu/|mu|, with the largest gamma
+    satisfying ``predicate(row)``."""
+    mu = honest.mean(axis=0)
+    p = -mu / max(np.linalg.norm(mu), 1e-12)
+    diff = honest[:, None, :] - honest[None, :, :]
+    pair = (diff**2).sum(axis=-1)
+    dev = np.linalg.norm(honest - mu[None, :], axis=1)
+    lo, hi = 0.0, float(np.sqrt(pair.max()) + dev.max() + 1.0)
+    for _ in range(iters):
+        mid = 0.5 * (lo + hi)
+        if predicate(mu + mid * p, pair):
+            lo = mid
+        else:
+            hi = mid
+    return (mu + lo * p).astype(np.float32)
+
+
+def _gamma_row(honest: np.ndarray, gamma: float) -> np.ndarray:
+    # mu + gamma*p with p = -mu/|mu| — the fixed-gamma bypass of _agr_row
+    mu = honest.mean(axis=0)
+    return mu - gamma * mu / max(np.linalg.norm(mu), 1e-12)
+
+
+def minmax(w: np.ndarray, byz_size: int, gamma: Optional[float] = None) -> np.ndarray:
+    """Oracle for the framework's min-max AGR-agnostic attack."""
+    out = w.copy()
+    honest = w[:-byz_size]
+    if gamma is not None:
+        row = _gamma_row(honest, gamma)
+    else:
+        row = _agr_row(
+            honest,
+            lambda m, pair: ((honest - m) ** 2).sum(axis=1).max() <= pair.max(),
+        )
+    out[-byz_size:] = row
+    return out
+
+
+def minsum(w: np.ndarray, byz_size: int, gamma: Optional[float] = None) -> np.ndarray:
+    """Oracle for the framework's min-sum AGR-agnostic attack."""
+    out = w.copy()
+    honest = w[:-byz_size]
+    if gamma is not None:
+        row = _gamma_row(honest, gamma)
+    else:
+        row = _agr_row(
+            honest,
+            lambda m, pair: ((honest - m) ** 2).sum() <= pair.sum(axis=1).max(),
+        )
+    out[-byz_size:] = row
+    return out

@@ -75,14 +75,15 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
     cfg.validate()
     _KNOWN_ATTACKS = {
         "classflip", "dataflip", "gradascent", "weightflip", "signflip",
-        "alie", "ipm", "gaussian",
+        "alie", "ipm", "gaussian", "minmax", "minsum",
     }
     if cfg.attack is not None and cfg.attack not in _KNOWN_ATTACKS:
         raise KeyError(
             f"ref backend: unknown attack {cfg.attack!r}; known: "
             f"{sorted(_KNOWN_ATTACKS)}"
         )
-    _PARAM_ATTACKS = {"alie", "ipm", "gaussian"}  # same contract as AttackSpec
+    # same contract as AttackSpec.param_name
+    _PARAM_ATTACKS = {"alie", "ipm", "gaussian", "minmax", "minsum"}
     if cfg.attack_param is not None and cfg.attack not in _PARAM_ATTACKS:
         raise ValueError(
             f"attack {cfg.attack!r} takes no scalar parameter"
@@ -147,6 +148,14 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
                 w_stack[-cfg.byz_size :] = sigma * rng.normal(
                     size=(cfg.byz_size, flat.size)
                 ).astype(np.float32)
+            elif cfg.attack == "minmax" and cfg.byz_size:
+                w_stack = numpy_ref.minmax(
+                    w_stack, cfg.byz_size, gamma=cfg.attack_param
+                )
+            elif cfg.attack == "minsum" and cfg.byz_size:
+                w_stack = numpy_ref.minsum(
+                    w_stack, cfg.byz_size, gamma=cfg.attack_param
+                )
 
             # channel-dispatch rule (mirrors ops.aggregators.needs_oma_prepass):
             # gm and signmv run their own over-the-air transmission

@@ -100,7 +100,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="Weiszfeld step implementation (pallas = fused TPU kernel)",
     )
     p.add_argument("--attack-param", type=float, default=None,
-                   help="scalar attack magnitude (alie z / ipm eps / gaussian sigma)")
+                   help="scalar attack magnitude (alie z / ipm eps / gaussian "
+                        "sigma / minmax+minsum fixed gamma)")
     p.add_argument("--krum-m", type=int, default=None,
                    help="multi-Krum selection count (default: honest size)")
     p.add_argument("--clip-tau", type=float, default=10.0,

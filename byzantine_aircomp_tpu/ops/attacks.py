@@ -21,10 +21,12 @@ honest and Byzantine clients), and the message transform maps
 ``byz_size`` rows, matching the reference's layout (``:291-341``).
 
 Beyond the reference's three attacks we ship ``signflip``, ``gradascent`` and
-``gaussian`` per the BASELINE.json scale-up configs, plus two standard
+``gaussian`` per the BASELINE.json scale-up configs, plus four standard
 omniscient attacks from the Byzantine literature: ``alie`` ("A Little Is
-Enough", Baruch et al. 2019) and ``ipm`` (Inner-Product Manipulation, Xie
-et al. 2020).
+Enough", Baruch et al. 2019), ``ipm`` (Inner-Product Manipulation, Xie
+et al. 2020), and the AGR-agnostic ``minmax`` / ``minsum`` (Shejwalkar &
+Houmansadr, NDSS 2021), whose in-jit bisection finds the largest
+perturbation that stays indistinguishable from honest disagreement.
 """
 
 from __future__ import annotations
@@ -130,6 +132,83 @@ def _ipm_message(wmatrix, byz_size, key, eps: float = 0.5):
     return jnp.concatenate([honest, byz], axis=0)
 
 
+def _agr_malicious_row(honest, gamma_iters: int, predicate):
+    """Shared machinery of the AGR-agnostic attacks (Shejwalkar &
+    Houmansadr, NDSS 2021): the malicious row is mu + gamma*p with p the
+    unit perturbation toward -mu, and gamma the LARGEST value satisfying
+    ``predicate`` (an indistinguishability constraint against the honest
+    rows), found by fixed-iteration bisection so the whole search jits.
+    gamma = 0 (the honest mean itself) always satisfies both constraints
+    (the mean lies in the honest convex hull / minimizes the summed squared
+    distances), so the bracket [0, hi] with an infeasibly large hi always
+    converges."""
+    mu = jnp.mean(honest, axis=0)
+    p = -mu / jnp.maximum(jnp.linalg.norm(mu), 1e-12)
+    dev = jnp.linalg.norm(honest - mu[None, :], axis=1)
+    pair = _pairwise_sq_dists(honest)
+    # ||mu + gamma*p - w_i|| >= gamma - dev_i, so gamma beyond
+    # sqrt(max pair dist) + max dev violates any distance-cap constraint
+    hi = jnp.sqrt(jnp.max(pair)) + jnp.max(dev) + 1.0
+
+    def bisect(carry, _):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        ok = predicate(mu + mid * p, pair)
+        return (jnp.where(ok, mid, lo), jnp.where(ok, hi, mid)), None
+
+    (gamma, _), _ = jax.lax.scan(
+        bisect, (jnp.float32(0.0), hi), None, length=gamma_iters
+    )
+    return mu + gamma * p
+
+
+def _pairwise_sq_dists(h):
+    sq = jnp.sum(h * h, axis=1)
+    gram = jnp.dot(h, h.T, preferred_element_type=jnp.float32)
+    return jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * gram, 0.0)
+
+
+def _fixed_gamma_row(honest, gamma):
+    # the same mu + gamma*p construction as _agr_malicious_row, with the
+    # bisection bypassed by an explicit gamma (--attack-param)
+    mu = jnp.mean(honest, axis=0)
+    p = -mu / jnp.maximum(jnp.linalg.norm(mu), 1e-12)
+    return mu + jnp.float32(gamma) * p
+
+
+def _agr_message(wmatrix, byz_size, gamma, predicate):
+    honest = wmatrix[:-byz_size]
+    if gamma is not None:
+        m = _fixed_gamma_row(honest, gamma)
+    else:
+        m = _agr_malicious_row(honest, 25, lambda mm, pair: predicate(honest, mm, pair))
+    byz = jnp.broadcast_to(m, wmatrix[-byz_size:].shape)
+    return jnp.concatenate([honest, byz], axis=0)
+
+
+def _minmax_message(wmatrix, byz_size, key, gamma: float = None):
+    # min-max AGR-agnostic attack: push as far as possible along -mu while
+    # the malicious row's max distance to any honest row stays within the
+    # max pairwise honest distance — indistinguishable to distance-cap
+    # defenses (Krum, cclip) yet maximally displacing
+    def pred(honest, m, pair):
+        d = jnp.sum((honest - m[None, :]) ** 2, axis=1)
+        return jnp.max(d) <= jnp.max(pair)
+
+    return _agr_message(wmatrix, byz_size, gamma, pred)
+
+
+def _minsum_message(wmatrix, byz_size, key, gamma: float = None):
+    # min-sum variant: the malicious row's SUM of squared distances to the
+    # honest rows stays within the worst honest row's sum — the tighter
+    # constraint, stealthier against score-sum defenses (multi-Krum, Bulyan)
+    def pred(honest, m, pair):
+        d = jnp.sum((honest - m[None, :]) ** 2, axis=1)
+        return jnp.sum(d) <= jnp.max(jnp.sum(pair, axis=1))
+
+    return _agr_message(wmatrix, byz_size, gamma, pred)
+
+
 ATTACKS.register("classflip")(AttackSpec("classflip", data_fn=_classflip_data))
 ATTACKS.register("dataflip")(AttackSpec("dataflip", data_fn=_dataflip_data))
 ATTACKS.register("weightflip")(
@@ -145,6 +224,12 @@ ATTACKS.register("ipm")(
 )
 ATTACKS.register("gaussian")(
     AttackSpec("gaussian", message_fn=_gaussian_message, param_name="sigma")
+)
+ATTACKS.register("minmax")(
+    AttackSpec("minmax", message_fn=_minmax_message, param_name="gamma")
+)
+ATTACKS.register("minsum")(
+    AttackSpec("minsum", message_fn=_minsum_message, param_name="gamma")
 )
 
 

@@ -136,3 +136,52 @@ def test_attack_param_scales_alie_and_ipm():
 
     with pytest.raises(ValueError):
         attacks.resolve("weightflip").apply_message(jnp.asarray(w), 3, param=1.0)
+
+
+def test_minmax_constraint_and_displacement():
+    # the malicious row must satisfy the min-max indistinguishability
+    # constraint (max distance to any honest row <= max pairwise honest
+    # distance) while sitting measurably away from the honest mean
+    rng = np.random.default_rng(41)
+    w = rng.normal(size=(20, 30)).astype(np.float32)
+    spec = attacks.resolve("minmax")
+    out = np.asarray(spec.apply_message(jnp.asarray(w), 5, None))
+    honest, byz = out[:-5], out[-5:]
+    np.testing.assert_array_equal(honest, w[:-5])
+    assert (byz == byz[0]).all()  # identical malicious rows
+    pair = ((honest[:, None] - honest[None, :]) ** 2).sum(-1)
+    d = ((honest - byz[0]) ** 2).sum(-1)
+    assert d.max() <= pair.max() * (1 + 1e-5)
+    mu = honest.mean(0)
+    # bisection pushed gamma well past zero
+    assert np.linalg.norm(byz[0] - mu) > 0.1 * np.sqrt(pair.max())
+
+
+def test_minsum_constraint():
+    rng = np.random.default_rng(43)
+    w = rng.normal(size=(16, 24)).astype(np.float32)
+    spec = attacks.resolve("minsum")
+    out = np.asarray(spec.apply_message(jnp.asarray(w), 4, None))
+    honest, byz = out[:-4], out[-4:]
+    pair = ((honest[:, None] - honest[None, :]) ** 2).sum(-1)
+    d = ((honest - byz[0]) ** 2).sum(-1)
+    assert d.sum() <= pair.sum(axis=1).max() * (1 + 1e-5)
+    # min-sum's constraint is tighter than min-max's displacement
+    mu = honest.mean(0)
+    assert np.linalg.norm(byz[0] - mu) > 0.0
+
+
+def test_minmax_minsum_match_oracle():
+    rng = np.random.default_rng(47)
+    w = rng.normal(size=(14, 19)).astype(np.float32)
+    for name, oracle in (("minmax", numpy_ref.minmax), ("minsum", numpy_ref.minsum)):
+        spec = attacks.resolve(name)
+        got = np.asarray(spec.apply_message(jnp.asarray(w), 3, None))
+        want = oracle(w, 3)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+        # fixed-gamma override bypasses the bisection identically
+        got_g = np.asarray(
+            spec.apply_message(jnp.asarray(w), 3, None, param=0.25)
+        )
+        want_g = oracle(w, 3, gamma=0.25)
+        np.testing.assert_allclose(got_g, want_g, rtol=1e-5, atol=1e-6)
