@@ -51,16 +51,29 @@ def multi_krum(w: np.ndarray, honest_size: int, m: Optional[int] = None) -> np.n
     return w[idx].mean(axis=0)
 
 
+def _exclude_nonfinite_rows(w: np.ndarray) -> "tuple[np.ndarray, np.ndarray]":
+    """(masked stack, per-row finite mask): rows containing Inf/NaN zeroed —
+    the iterative aggregators (gm/gm2/centered_clip) EXCLUDE non-finite
+    rows, same semantics as the JAX paths (ops.aggregators._finite_rows)."""
+    finite = np.isfinite(w).all(axis=1)
+    return np.where(finite[:, None], w, 0.0), finite
+
+
 def gm2(
     w: np.ndarray,
     guess: Optional[np.ndarray] = None,
     maxiter: int = 1000,
     tol: float = 1e-5,
 ) -> np.ndarray:
-    guess = w.mean(axis=0) if guess is None else guess.copy()
+    w, finite = _exclude_nonfinite_rows(w)
+    if guess is None:
+        guess = w.sum(axis=0) / max(finite.sum(), 1)
+    else:
+        guess = guess.copy()
     for _ in range(maxiter):
         dist = np.maximum(DIST_CLAMP, np.linalg.norm(w - guess, axis=1))
-        nxt = (w / dist[:, None]).sum(axis=0) / (1.0 / dist).sum()
+        inv = np.where(finite, 1.0 / dist, 0.0)
+        nxt = (w * inv[:, None]).sum(axis=0) / inv.sum()
         movement = np.linalg.norm(guess - nxt)
         guess = nxt
         if movement <= tol:
@@ -109,11 +122,16 @@ def gm(
     tol: float = 1e-5,
     p_max: float = 1.0,
 ) -> np.ndarray:
-    guess = w.mean(axis=0) if guess is None else guess.copy()
+    w, finite = _exclude_nonfinite_rows(w)
+    if guess is None:
+        guess = w.sum(axis=0) / max(finite.sum(), 1)
+    else:
+        guess = guess.copy()
     for _ in range(maxiter):
         scaler = math.sqrt(float((guess**2).mean()))
         dist = np.maximum(DIST_CLAMP, np.linalg.norm(w - guess, axis=1))
-        msg = np.concatenate([w / dist[:, None], scaler / dist[:, None]], axis=1)
+        inv = np.where(finite, 1.0 / dist, 0.0)
+        msg = np.concatenate([w * inv[:, None], scaler * inv[:, None]], axis=1)
         noisy = oma2(
             rng, msg, p_max=p_max, noise_var=noise_var, threshold=500.0 * scaler**2
         )
@@ -192,9 +210,13 @@ def centered_clip(
 ) -> np.ndarray:
     """Oracle for the framework's centered-clipping aggregator (an
     extension; Karimireddy et al. 2021): v += mean(clip(w_i - v, tau))."""
-    v = w.mean(axis=0) if guess is None else np.asarray(guess, np.float64)
+    w, finite = _exclude_nonfinite_rows(w)
+    if guess is None:
+        v = w.sum(axis=0) / max(finite.sum(), 1)
+    else:
+        v = np.asarray(guess, np.float64)
     for _ in range(clip_iters):
-        delta = w - v[None, :]
+        delta = np.where(finite[:, None], w - v[None, :], 0.0)
         norms = np.maximum(np.linalg.norm(delta, axis=1), 1e-12)
         scale = np.minimum(1.0, clip_tau / norms)
         v = v + (delta * scale[:, None]).mean(axis=0)

@@ -42,6 +42,32 @@ def _centroid(wmatrix):
     return jnp.mean(wmatrix, axis=0)
 
 
+def _finite_rows(wmatrix):
+    """Per-row all-finite mask [K].  The iterative aggregators (gm/gm2/cclip)
+    EXCLUDE non-finite rows — an overflowed Byzantine row is a point at
+    infinity, whose Weiszfeld weight is 0 — instead of letting a single
+    Inf/NaN coordinate poison every arithmetic pass (0*Inf, Inf-Inf)."""
+    return jnp.all(jnp.isfinite(wmatrix), axis=1)
+
+
+def _mask_rows(wmatrix, finite):
+    """Non-finite rows selected to 0.  On the XLA paths this is built
+    per-consumer so the select fuses into the reduction — no sanitized
+    [K, d] copy persists at large d; only the fused-pallas path (small d by
+    ``supports_fused``) materializes it once."""
+    return jnp.where(finite[:, None], wmatrix, 0.0)
+
+
+def _finite_centroid(wmatrix, finite):
+    # the max(.., 1) only keeps THIS division defined; a stack with zero
+    # finite rows is unsupported (the subsequent num/den step divides by
+    # den = 0 and the aggregate is NaN regardless — config guarantees
+    # honest rows exist, and honest rows are finite)
+    return jnp.sum(_mask_rows(wmatrix, finite), axis=0) / jnp.maximum(
+        jnp.sum(finite), 1.0
+    )
+
+
 @AGGREGATORS.register("mean")
 def mean(wmatrix: jnp.ndarray, **_) -> jnp.ndarray:
     """Column mean (reference ``mean``, ``:186-187``)."""
@@ -238,11 +264,14 @@ def centered_clip(
 
     A single Byzantine row can displace the center by at most tau/K per
     step, whatever its magnitude.  The fixed small iteration count keeps the
-    program static (no data-dependent while_loop needed at this cost)."""
-    v = _centroid(wmatrix) if guess is None else guess
+    program static (no data-dependent while_loop needed at this cost).
+    Non-finite rows are excluded (their delta selected to 0 — a zero vote;
+    tau/Inf*Inf would otherwise inject NaN)."""
+    finite = _finite_rows(wmatrix)
+    v = _finite_centroid(wmatrix, finite) if guess is None else guess
 
     def step(v, _):
-        delta = wmatrix - v[None, :]
+        delta = jnp.where(finite[:, None], wmatrix - v[None, :], 0.0)
         norms = jnp.maximum(jnp.linalg.norm(delta, axis=1), 1e-12)
         scale = jnp.minimum(1.0, clip_tau / norms)
         return v + jnp.mean(delta * scale[:, None], axis=0), None
@@ -386,11 +415,22 @@ def gm2(
     step as the fused single-HBM-pass kernel
     (:func:`.pallas_kernels.weiszfeld_step`) when the model fits the fused
     regime; XLA's two-pass lowering otherwise.
+
+    Non-finite rows are EXCLUDED (weight 0): the XLA path selects their
+    contributions to 0 per iteration (the select fuses into the reduction —
+    no persistent sanitized copy at large d); the pallas path runs on the
+    zeroed stack once — a zeroed row contributes nothing to ``num`` and
+    exactly ``1/max(clamp, |g|)`` to ``den``, which is subtracted back out,
+    so the fused kernel needs no mask plumbing.
     """
-    init_guess = _centroid(wmatrix) if guess is None else guess
+    finite = _finite_rows(wmatrix)
+    init_guess = _finite_centroid(wmatrix, finite) if guess is None else guess
     use_pallas = impl == "pallas" and pallas_kernels.supports_fused(
         wmatrix.shape[1]
     )
+    if use_pallas:
+        w_san = _mask_rows(wmatrix, finite)  # small-d regime only
+        n_bad = jnp.sum(~finite).astype(jnp.float32)
 
     def cond(state):
         i, _, movement = state
@@ -399,11 +439,14 @@ def gm2(
     def body(state):
         i, g, _ = state
         if use_pallas:
-            num, den = pallas_kernels.weiszfeld_step(wmatrix, g)
+            num, den = pallas_kernels.weiszfeld_step(w_san, g)
+            den = den - n_bad / jnp.maximum(DIST_CLAMP, jnp.linalg.norm(g))
         else:
             dist = _weiszfeld_dists(wmatrix, g)
-            inv = 1.0 / dist
-            num = jnp.sum(wmatrix * inv[:, None], axis=0)
+            inv = jnp.where(finite, 1.0 / dist, 0.0)
+            num = jnp.sum(
+                jnp.where(finite[:, None], wmatrix * inv[:, None], 0.0), axis=0
+            )
             den = jnp.sum(inv)
         g_next = num / den
         movement = jnp.linalg.norm(g - g_next)
@@ -443,10 +486,19 @@ def gm(
     receiver noise are drawn with the SAME key derivation as the XLA path
     (``oma2``'s ``split(sub) -> (key_h, key_n)``), so both impls consume an
     identical RNG stream.
+
+    Non-finite rows are EXCLUDED (they transmit nothing): the XLA path
+    zeroes their messages via the masked inverse distance; the pallas path
+    runs on the zeroed stack and subtracts the zeroed rows' analytic
+    denominator contribution ``gain0 * scaler / max(clamp, |g|)`` (their
+    numerator term is exactly 0).
     """
-    init_guess = _centroid(wmatrix) if guess is None else guess
+    finite = _finite_rows(wmatrix)
+    init_guess = _finite_centroid(wmatrix, finite) if guess is None else guess
     k_clients, d = wmatrix.shape
     use_pallas = impl == "pallas" and pallas_kernels.supports_fused(d)
+    if use_pallas:
+        w_san = _mask_rows(wmatrix, finite)  # small-d regime only
 
     def cond(state):
         i, _, movement, _ = state
@@ -459,8 +511,20 @@ def gm(
         if use_pallas:
             key_h, key_n = jax.random.split(sub)
             h_r, h_i = channel.rayleigh_fade(key_h, k_clients)
+            h_sq = h_r**2 + h_i**2
             num, den = pallas_kernels.aircomp_weiszfeld_step(
-                wmatrix, g, h_r**2 + h_i**2, scaler, p_max=p_max
+                w_san, g, h_sq, scaler, p_max=p_max
+            )
+            # analytic contribution of a zeroed row (message [0.., scaler/d0]
+            # with d0 = max(clamp, |g|)), removed so exclusion is exact
+            inv0 = 1.0 / jnp.maximum(DIST_CLAMP, jnp.linalg.norm(g))
+            p_msg0 = inv0**2 * scaler**2 / (d + 1.0) / h_sq
+            gain0 = jnp.sqrt(
+                p_max
+                / jnp.maximum(p_msg0, GM_THRESHOLD_FACTOR * scaler**2)
+            )
+            den = den - jnp.sum(
+                jnp.where(finite, 0.0, gain0 * inv0 * scaler)
             )
             if noise_var is not None:
                 scale = jnp.sqrt(jnp.asarray(noise_var, jnp.float32) / 2.0)
@@ -470,8 +534,11 @@ def gm(
             g_next = num / den * scaler
         else:
             dist = _weiszfeld_dists(wmatrix, g)
-            inv = (1.0 / dist)[:, None]
-            message = jnp.concatenate([wmatrix * inv, scaler * inv], axis=1)
+            inv = jnp.where(finite, 1.0 / dist, 0.0)[:, None]
+            message = jnp.concatenate(
+                [jnp.where(finite[:, None], wmatrix * inv, 0.0), scaler * inv],
+                axis=1,
+            )
             noisy = channel.oma2(
                 sub,
                 message,

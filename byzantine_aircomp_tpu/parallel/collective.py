@@ -93,11 +93,23 @@ def sharded_weiszfeld_step(
     """
 
     def local(w, g):
-        d_part = jnp.sum((w - g[None, :]) ** 2, axis=1)
+        # full-row finiteness spans the model shards: one tiny [K/P] psum.
+        # Non-finite rows are EXCLUDED (weight 0), matching the single-
+        # device gm2 — without the mask, inv=0 times an Inf coordinate
+        # would psum NaN into every output coordinate.
+        finite = (
+            jax.lax.psum(
+                jnp.any(~jnp.isfinite(w), axis=1).astype(jnp.float32),
+                MODEL_AXIS,
+            )
+            == 0.0
+        )
+        wm = jnp.where(finite[:, None], w, 0.0)
+        d_part = jnp.sum((wm - g[None, :]) ** 2, axis=1)
         dist = jnp.sqrt(jax.lax.psum(d_part, MODEL_AXIS))
         dist = jnp.maximum(clamp, dist)
-        inv = 1.0 / dist
-        num = jax.lax.psum(jnp.sum(w * inv[:, None], axis=0), CLIENT_AXIS)
+        inv = jnp.where(finite, 1.0 / dist, 0.0)
+        num = jax.lax.psum(jnp.sum(wm * inv[:, None], axis=0), CLIENT_AXIS)
         den = jax.lax.psum(jnp.sum(inv), CLIENT_AXIS)
         return num / den
 

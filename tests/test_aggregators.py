@@ -406,3 +406,25 @@ def test_signmv_blocked_path_matches_dense(monkeypatch):
     bn = np.asarray(agg.sign_majority_vote(
         jnp.asarray(w), guess=jnp.asarray(guess), key=key, noise_var=1e-2))
     np.testing.assert_array_equal(dn, bn)
+
+
+def test_gm2_and_cclip_exclude_nonfinite_rows_like_oracle():
+    # an overflowed Byzantine row is excluded (Weiszfeld weight 0 / zero
+    # clip vote) in both the jax path and the numpy oracle
+    rng = np.random.default_rng(59)
+    w = (0.05 * rng.normal(size=(12, 30))).astype(np.float32)
+    w[-1] = np.inf
+    w[-2, 4] = np.nan
+    guess = w[:-2].mean(axis=0)
+    got = np.asarray(
+        agg.gm2(jnp.asarray(w), guess=jnp.asarray(guess), maxiter=100, tol=1e-7)
+    )
+    want = numpy_ref.gm2(w, guess=guess, maxiter=100, tol=1e-7)
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    got_c = np.asarray(
+        agg.centered_clip(jnp.asarray(w), guess=jnp.asarray(guess), clip_tau=1.0)
+    )
+    want_c = numpy_ref.centered_clip(w, guess=guess, clip_tau=1.0)
+    assert np.isfinite(got_c).all()
+    np.testing.assert_allclose(got_c, want_c, rtol=1e-4, atol=1e-6)

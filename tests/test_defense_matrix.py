@@ -1,0 +1,93 @@
+"""Combinatorial smoke: every registered aggregator against every registered
+message/data attack at the stack level.
+
+The unit suites verify each aggregator and attack in isolation against
+oracles; this matrix catches bad PAIRINGS — an attack emitting a stack shape
+or magnitude some defense mishandles (the Inf/NaN hardening in
+ops.aggregators started as exactly such a pairing bug).  Runs eagerly on a
+small realistic stack (tight honest cluster one SGD step apart, like the
+training regime) so the whole matrix stays cheap.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu.ops import aggregators as agg_lib
+from byzantine_aircomp_tpu.ops import attacks as attack_lib
+from byzantine_aircomp_tpu.registry import AGGREGATORS, ATTACKS
+
+K, B, D = 16, 3, 24
+HONEST = K - B
+
+
+def _stack():
+    key = jax.random.PRNGKey(0)
+    base = 0.05 * jax.random.normal(jax.random.fold_in(key, 1), (D,))
+    w = base[None, :] + 1e-3 * jax.random.normal(
+        jax.random.fold_in(key, 2), (K, D)
+    )
+    return w.astype(jnp.float32), base.astype(jnp.float32)
+
+
+@pytest.mark.parametrize("attack_name", sorted(ATTACKS.names()))
+@pytest.mark.parametrize("agg_name", sorted(AGGREGATORS.names()))
+def test_every_aggregator_survives_every_attack(agg_name, attack_name):
+    if agg_name == "Krum":  # alias of krum
+        pytest.skip("alias")
+    w, guess = _stack()
+    spec = attack_lib.resolve(attack_name)
+    key = jax.random.PRNGKey(7)
+    w_att = spec.apply_message(w, B, key)
+    assert w_att.shape == w.shape
+
+    fn = agg_lib.resolve(agg_name)
+    out = fn(
+        w_att,
+        honest_size=HONEST,
+        key=jax.random.fold_in(key, 1),
+        noise_var=1e-2 if agg_name in ("gm", "signmv") else None,
+        guess=guess,
+        maxiter=50,
+        tol=1e-5,
+        impl="xla",
+        m=None,
+        clip_tau=10.0,
+        clip_iters=3,
+        sign_eta=None,
+    )
+    out = np.asarray(out)
+    assert out.shape == (D,)
+    assert np.isfinite(out).all(), f"{agg_name} x {attack_name} -> non-finite"
+
+
+@pytest.mark.parametrize("agg_name", sorted(AGGREGATORS.names()))
+def test_every_aggregator_survives_an_overflowed_row(agg_name):
+    # one Byzantine row at +-Inf/NaN: no defense may propagate non-finite
+    # values into the aggregate (mean is exempt by definition — averaging IS
+    # its contract; everything robust must survive)
+    if agg_name in ("Krum", "mean"):
+        pytest.skip("alias / mean is non-robust by contract")
+    w, guess = _stack()
+    w = w.at[-1].set(jnp.inf)
+    w = w.at[-1, 0].set(jnp.nan)
+    fn = agg_lib.resolve(agg_name)
+    out = np.asarray(
+        fn(
+            w,
+            honest_size=HONEST,
+            key=jax.random.PRNGKey(3),
+            noise_var=None,
+            guess=guess,
+            maxiter=50,
+            tol=1e-5,
+            impl="xla",
+            m=None,
+            clip_tau=10.0,
+            clip_iters=3,
+            sign_eta=None,
+        )
+    )
+    assert out.shape == (D,)
+    assert np.isfinite(out).all(), f"{agg_name} leaked the overflowed row"

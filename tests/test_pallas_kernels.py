@@ -98,3 +98,50 @@ def test_trainer_runs_with_pallas_impl():
     tr = FedTrainer(cfg, dataset=ds)
     tr.run_round(0)
     assert jnp.isfinite(tr.flat_params).all()
+
+
+def test_gm2_pallas_excludes_nonfinite_rows_like_xla():
+    # the pallas path runs on the zeroed stack and subtracts the zeroed
+    # rows' denominator term; both impls must agree on the exclusion
+    import numpy as np
+
+    from byzantine_aircomp_tpu.ops import aggregators as agg
+
+    rng = np.random.default_rng(51)
+    w = rng.normal(size=(12, 40)).astype(np.float32) * 0.05
+    w[-2] = np.inf
+    w[-1, 3] = np.nan
+    guess = w[:-2].mean(axis=0)
+    out_x = np.asarray(
+        agg.gm2(jnp.asarray(w), guess=jnp.asarray(guess), maxiter=40,
+                tol=1e-6, impl="xla")
+    )
+    out_p = np.asarray(
+        agg.gm2(jnp.asarray(w), guess=jnp.asarray(guess), maxiter=40,
+                tol=1e-6, impl="pallas")
+    )
+    assert np.isfinite(out_x).all() and np.isfinite(out_p).all()
+    np.testing.assert_allclose(out_p, out_x, rtol=1e-4, atol=1e-6)
+
+
+def test_gm_pallas_excludes_nonfinite_rows_like_xla():
+    import numpy as np
+
+    from byzantine_aircomp_tpu.ops import aggregators as agg
+
+    rng = np.random.default_rng(53)
+    base = rng.normal(size=40).astype(np.float32) * 0.05
+    w = base[None, :] + 1e-3 * rng.normal(size=(12, 40)).astype(np.float32)
+    w[-1] = -np.inf
+    guess = jnp.asarray(base)
+    key = jax.random.PRNGKey(11)
+    out_x = np.asarray(
+        agg.gm(jnp.asarray(w), key=key, noise_var=None, guess=guess,
+               maxiter=30, tol=1e-6, impl="xla")
+    )
+    out_p = np.asarray(
+        agg.gm(jnp.asarray(w), key=key, noise_var=None, guess=guess,
+               maxiter=30, tol=1e-6, impl="pallas")
+    )
+    assert np.isfinite(out_x).all() and np.isfinite(out_p).all()
+    np.testing.assert_allclose(out_p, out_x, rtol=1e-3, atol=1e-5)

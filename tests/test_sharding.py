@@ -217,3 +217,21 @@ def test_ring_krum_and_bulyan_survive_inf_row():
         assert np.isfinite(got_b).all(), col_sign
         want_b = np.asarray(agg_lib.bulyan(w, honest_size=13))
         np.testing.assert_allclose(got_b, want_b, rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_weiszfeld_step_excludes_nonfinite_rows():
+    # the explicit-collective Weiszfeld step must exclude overflowed rows
+    # exactly like the single-device gm2 (weight 0), not psum their NaN
+    m = mesh_lib.make_mesh(model_parallel=2)
+    w = 0.05 * jax.random.normal(jax.random.PRNGKey(9), (16, 256))
+    w = w.at[-1].set(jnp.inf)
+    guess = jnp.mean(w[:-1], axis=0)
+    got = np.asarray(collective.sharded_weiszfeld_step(m, w, guess))
+    assert np.isfinite(got).all()
+    # one dense masked step as the reference
+    finite = np.isfinite(np.asarray(w)).all(axis=1)
+    wn = np.where(finite[:, None], np.asarray(w), 0.0)
+    dist = np.maximum(1e-4, np.linalg.norm(wn - np.asarray(guess), axis=1))
+    inv = np.where(finite, 1.0 / dist, 0.0)
+    want = (wn * inv[:, None]).sum(axis=0) / inv.sum()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
