@@ -77,6 +77,8 @@ def test_air_sum_equals_oma2(noise_var, model_parallel):
         # (K=16, B=3 satisfies K > 4B)
         ("bulyan", None),
         ("cclip", None),
+        # one-bit OTA majority vote, incl. its receiver noise on the votes
+        ("signmv", 1e-3),
     ],
 )
 def test_sharded_trainer_matches_single_device(agg, noise_var, model_parallel):
