@@ -33,6 +33,7 @@ ARG_TO_FIELD = {
     "inherit": ("inherit", None),
     "sharding": ("sharded", _SHARDING.get),
     "agg_impl": ("agg_impl", None),
+    "gather_impl": ("gather_impl", None),
     "prng_impl": ("prng_impl", None),
     "attack_param": ("attack_param", None),
     "krum_m": ("krum_m", None),
@@ -98,6 +99,13 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["auto", "xla", "pallas"],
         default="auto",
         help="Weiszfeld step implementation (pallas = fused TPU kernel)",
+    )
+    p.add_argument(
+        "--gather-impl",
+        choices=["xla", "pallas"],
+        default="xla",
+        help="client-batch assembly (pallas = fused u8 gather+normalize "
+             "kernel; experimental, measure before adopting)",
     )
     p.add_argument("--attack-param", type=float, default=None,
                    help="scalar attack magnitude (alie z / ipm eps / gaussian "
