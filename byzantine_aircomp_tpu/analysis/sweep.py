@@ -1,0 +1,162 @@
+"""Defense-vs-attack sweep: run the full (aggregator x attack) matrix and
+tabulate final accuracy.
+
+The reference's evaluation workflow is one run per CLI invocation plus a
+hand-assembled notebook figure (``draw.ipynb``); at this framework's speed a
+whole robustness matrix is cheap, so the sweep is first-class tooling:
+
+    python -m byzantine_aircomp_tpu.sweep --aggs gm2,krum,signmv \
+        --attacks classflip,alie,minmax --K 50 --B 10 --rounds 5
+
+Each cell trains from scratch (same seed, same dataset object — loaded
+once) and reports final val accuracy/loss and rounds/sec.  Output: one JSON
+line per cell on stdout plus a markdown table on stderr, and optionally a
+pickle of the full grid (``--out``) for downstream plotting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pickle
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..fed.config import FedConfig
+from ..fed.train import FedTrainer
+from ..registry import AGGREGATORS, ATTACKS
+
+
+def run_cell(
+    agg: str, attack: Optional[str], cfg_kw: dict, dataset
+) -> Dict[str, float]:
+    """Train one (aggregator, attack) cell; returns its final metrics.
+
+    ``rounds_per_sec`` excludes compile and eval: round 0 is the warmup
+    (it triggers tracing) and the timer stops before ``evaluate`` — with
+    ``rounds=1`` there is nothing post-compile to time, so the field is
+    omitted."""
+    import jax.numpy as jnp
+
+    kw = dict(cfg_kw)
+    kw["agg"] = agg
+    kw["attack"] = attack
+    if attack is None:
+        kw["byz_size"] = 0  # reference semantics (run(), :430-431)
+    cfg = FedConfig(**kw)
+    trainer = FedTrainer(cfg, dataset=dataset)
+    # the single-round program is shape-independent, so round 0 both warms
+    # up (compiles) and advances the trajectory; rounds 1..R-1 re-dispatch
+    # the same compiled program inside the timed window
+    trainer.run_round(0)
+    float(jnp.sum(trainer.flat_params))  # honest completion barrier
+    metrics: Dict[str, float] = {}
+    if cfg.rounds > 1:
+        t0 = time.perf_counter()
+        for r in range(1, cfg.rounds):
+            trainer.run_round(r)
+        float(jnp.sum(trainer.flat_params))
+        dt = time.perf_counter() - t0
+        metrics["rounds_per_sec"] = round((cfg.rounds - 1) / dt, 3)
+    loss, acc = trainer.evaluate("val")
+    metrics.update(val_acc=round(acc, 4), val_loss=round(loss, 4))
+    return metrics
+
+
+def run_sweep(
+    aggs: List[str],
+    attacks: List[Optional[str]],
+    cfg_kw: dict,
+    dataset=None,
+    log=lambda s: print(s, file=sys.stderr, flush=True),
+    on_cell=None,
+) -> Dict[Tuple[str, Optional[str]], Dict[str, float]]:
+    """The full matrix; dataset is loaded once and shared across cells.
+    ``on_cell(agg, attack, metrics)`` fires as each cell completes, so
+    callers can stream results and a late-cell crash loses nothing."""
+    from ..data import datasets as data_lib
+
+    for a in aggs:
+        AGGREGATORS.get(a)  # fail fast on typos, before any training
+    for t in attacks:
+        if t is not None:
+            ATTACKS.get(t)
+    if dataset is None:
+        dataset = data_lib.load(cfg_kw.get("dataset", "mnist"))
+    grid: Dict[Tuple[str, Optional[str]], Dict[str, float]] = {}
+    for attack in attacks:
+        for agg in aggs:
+            cell = run_cell(agg, attack, cfg_kw, dataset)
+            grid[(agg, attack)] = cell
+            log(f"[sweep] agg={agg} attack={attack}: {cell}")
+            if on_cell is not None:
+                on_cell(agg, attack, cell)
+    return grid
+
+
+def markdown_table(
+    grid: Dict[Tuple[str, Optional[str]], Dict[str, float]],
+    metric: str = "val_acc",
+) -> str:
+    aggs = sorted({a for a, _ in grid})
+    attacks = sorted({t for _, t in grid}, key=lambda t: (t is not None, t))
+    head = "| attack \\ agg | " + " | ".join(aggs) + " |"
+    sep = "|" + "---|" * (len(aggs) + 1)
+    rows = []
+    for t in attacks:
+        cells = [f"{grid[(a, t)][metric]:.4f}" for a in aggs]
+        rows.append(f"| {t or 'none'} | " + " | ".join(cells) + " |")
+    return "\n".join([head, sep] + rows)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--aggs", default="gm2,krum,trimmed_mean,mean")
+    ap.add_argument("--attacks", default="none,classflip,weightflip")
+    ap.add_argument("--dataset", default="mnist")
+    ap.add_argument("--K", type=int, default=20)
+    ap.add_argument("--B", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--interval", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=50)
+    ap.add_argument("--gamma", type=float, default=1e-2)
+    ap.add_argument("--var", type=float, default=None)
+    ap.add_argument("--seed", type=int, default=2021)
+    ap.add_argument("--out", default=None, help="pickle the grid here")
+    args = ap.parse_args(argv)
+
+    aggs = [a for a in args.aggs.split(",") if a]
+    attacks: List[Optional[str]] = [
+        None if t in ("none", "") else t for t in args.attacks.split(",")
+    ]
+    cfg_kw = dict(
+        dataset=args.dataset,
+        honest_size=args.K - args.B,
+        byz_size=args.B,
+        rounds=args.rounds,
+        display_interval=args.interval,
+        batch_size=args.batch_size,
+        gamma=args.gamma,
+        noise_var=args.var,
+        seed=args.seed,
+        eval_train=False,
+    )
+    grid = run_sweep(
+        aggs,
+        attacks,
+        cfg_kw,
+        on_cell=lambda agg, attack, cell: print(
+            json.dumps({"agg": agg, "attack": attack or "none", **cell}),
+            flush=True,
+        ),
+    )
+    print(markdown_table(grid), file=sys.stderr, flush=True)
+    if args.out:
+        with open(args.out, "wb") as f:
+            pickle.dump({f"{a}|{t or 'none'}": c for (a, t), c in grid.items()}, f)
+        print(f"[sweep] grid pickled to {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -426,6 +426,11 @@ class FedTrainer:
             self._x_train_padded = jnp.pad(self.x_train, ((0, 0), (0, pad)))
             self._norm_scale_padded = jnp.pad(self._norm_scale, (0, pad))
             self._norm_bias_padded = jnp.pad(self._norm_bias, (0, pad))
+            # the unpadded device copy is dead from here on (eval reads the
+            # host-side dataset arrays); free it rather than keeping two
+            # full uint8 train sets in HBM
+            self.x_train.delete()
+            self.x_train = None
         return self._x_train_padded
 
     def _chunked(self, x: np.ndarray, y: np.ndarray):

@@ -1,0 +1,7 @@
+"""``python -m byzantine_aircomp_tpu.sweep`` — defense-vs-attack matrix
+(alias for :mod:`byzantine_aircomp_tpu.analysis.sweep`)."""
+
+from .analysis.sweep import main
+
+if __name__ == "__main__":
+    main()
