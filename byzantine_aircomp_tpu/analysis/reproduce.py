@@ -66,7 +66,11 @@ def main(argv=None) -> None:
             f"[reproduce] run {i + 1}/8: agg={cfg.agg} attack={cfg.attack} "
             f"B={cfg.byz_size} var={cfg.noise_var}"
         )
-        records[harness.run_title(cfg)] = harness.run(cfg)
+        # run_title alone is NOT unique here — it has no Byzantine count,
+        # so B=5 and B=10 share a title; suffix it like cache_path does
+        key = f"{harness.run_title(cfg)}_B{cfg.byz_size}"
+        records[key] = harness.run(cfg)
+    assert len(records) == 8, f"record keys collided: {sorted(records)}"
     paper_figure(records, args.out)
     print(f"wrote {args.out} ({len(records)} records)")
 

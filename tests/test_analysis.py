@@ -115,7 +115,20 @@ def test_reproduce_main_pipeline(tmp_path, monkeypatch):
         return rec
 
     monkeypatch.setattr(harness, "run", fake_run)
+    seen = {}
+    from byzantine_aircomp_tpu.analysis import plots
+
+    real_paper_figure = plots.paper_figure
+
+    def spy_figure(records, out_path=None, **kw):
+        seen["n"] = len(records)
+        return real_paper_figure(records, out_path, **kw)
+
+    # reproduce.main imports paper_figure from plots at call time
+    monkeypatch.setattr(plots, "paper_figure", spy_figure)
     out = tmp_path / "fig.png"
     reproduce.main(["--rounds", "1", "--cache-dir", str(tmp_path),
                     "--out", str(out)])
     assert out.exists() and out.stat().st_size > 0
+    # all 8 runs must reach the figure — run_title alone collides on B
+    assert seen["n"] == 8
