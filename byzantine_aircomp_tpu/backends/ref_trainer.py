@@ -64,11 +64,12 @@ def _eval(flat, x, y, d_in, n_cls):
 
 def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
     assert cfg.model == "MLP", "ref backend implements the MLP path only"
-    if cfg.local_steps != 1 or cfg.server_opt != "none":
+    if cfg.local_steps != 1 or cfg.server_opt != "none" or cfg.fedprox_mu:
         raise NotImplementedError(
             "ref backend implements the reference's FedSGD only "
-            "(local_steps=1, server_opt=none); got "
-            f"local_steps={cfg.local_steps}, server_opt={cfg.server_opt!r}"
+            "(local_steps=1, server_opt=none, fedprox_mu=0); got "
+            f"local_steps={cfg.local_steps}, server_opt={cfg.server_opt!r}, "
+            f"fedprox_mu={cfg.fedprox_mu}"
         )
     if cfg.attack is None:
         cfg.byz_size = 0

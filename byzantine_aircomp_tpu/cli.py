@@ -55,6 +55,7 @@ ARG_TO_FIELD = {
     "no_eval_train": ("eval_train", lambda v: not v),
     "eval_train": ("eval_train", None),
     "local_steps": ("local_steps", None),
+    "fedprox_mu": ("fedprox_mu", None),
     "server_opt": ("server_opt", None),
     "server_lr": ("server_lr", None),
     "server_momentum": ("server_momentum", None),
@@ -137,6 +138,13 @@ def build_parser() -> argparse.ArgumentParser:
         type=int,
         default=1,
         help="local SGD steps per client per iteration (1 = reference FedSGD)",
+    )
+    p.add_argument(
+        "--fedprox-mu",
+        type=float,
+        default=0.0,
+        help="FedProx proximal coefficient (anchors client drift when "
+             "--local-steps > 1; 0 = plain FedAvg/FedSGD)",
     )
     p.add_argument(
         "--server-opt",

@@ -30,6 +30,11 @@ class FedConfig:
     # FedSGD (MNIST_Air_weight.py:296-303); >1 = the FedAvg regime, each
     # step on a fresh with-replacement batch
     local_steps: int = 1
+    # FedProx (Li et al., MLSys 2020): proximal term mu*(w - w_global)
+    # added to each LOCAL step's gradient, anchoring client drift under
+    # local_steps > 1 (with one local step the anchor distance is 0, so
+    # mu has no effect — FedSGD is recovered exactly).  0 disables.
+    fedprox_mu: float = 0.0
     # server-side optimizer applied to the pseudo-gradient
     # (global_params - aggregated): "none" = take the aggregate directly
     # (reference semantics, :354-358); "momentum" = FedAvgM; "adam" = FedAdam
@@ -136,6 +141,9 @@ class FedConfig:
         )
         assert self.sign_eta is None or self.sign_eta > 0, (
             f"sign_eta must be positive when set, got {self.sign_eta}"
+        )
+        assert self.fedprox_mu >= 0, (
+            f"fedprox_mu must be >= 0, got {self.fedprox_mu}"
         )
         assert self.prng_impl in ("threefry", "rbg", "unsafe_rbg"), (
             f"prng_impl must be 'threefry', 'rbg' or 'unsafe_rbg', "

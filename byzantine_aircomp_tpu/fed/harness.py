@@ -43,6 +43,8 @@ def run_title(cfg: FedConfig) -> str:
     # titles AND differently-configured runs never collide on checkpoints
     if cfg.local_steps != 1:
         title += f"_E{cfg.local_steps}"
+    if cfg.fedprox_mu:
+        title += f"_prox{cfg.fedprox_mu}"
     if cfg.server_opt == "momentum":
         title += f"_momentum{cfg.server_lr}m{cfg.server_momentum}"
     elif cfg.server_opt != "none":

@@ -227,7 +227,8 @@ class FedTrainer:
         """Client weights after ``local_steps`` local SGD steps (FedAvg
         regime), each on its own batch: x_k [E, B, ...], y_k [E, B].
         Generalizes the reference's single step; gradient-scale attacks apply
-        at every local step."""
+        at every local step.  With ``fedprox_mu > 0`` each step's gradient
+        carries the FedProx proximal pull ``mu*(w - w_round_start)``."""
         cfg = self.cfg
         gscale = 1.0
         if self.attack is not None and self.attack.grad_scale != 1.0:
@@ -236,6 +237,8 @@ class FedTrainer:
         def step(w, xy):
             x_e, y_e = xy
             g = self._per_client_grad(w, x_e, y_e, is_byz) * gscale
+            if cfg.fedprox_mu:
+                g = g + cfg.fedprox_mu * (w - flat_params)
             return w - cfg.gamma * (g + cfg.weight_decay * w), None
 
         w_final, _ = jax.lax.scan(step, flat_params, (x_k, y_k))
