@@ -108,3 +108,26 @@ def test_krum_m_out_of_range_rejected():
         FedConfig(honest_size=8, byz_size=2, agg="multi_krum", krum_m=0).validate()
     with pytest.raises(AssertionError):
         FedConfig(honest_size=8, byz_size=2, agg="multi_krum", krum_m=11).validate()
+
+
+def test_profile_dir_writes_a_trace(tmp_path):
+    # --profile-dir wraps the run in jax.profiler.trace; the trace output
+    # must actually land on disk (the hook is otherwise easy to break
+    # silently since nothing consumes it in CI)
+    import os
+
+    from byzantine_aircomp_tpu.fed import harness
+
+    prof = tmp_path / "trace"
+    cfg = parse([
+        "--K", "6", "--B", "0", "--rounds", "1", "--interval", "2",
+        "--batch-size", "8", "--agg", "mean", "--no-eval-train",
+        "--profile-dir", str(prof), "--cache-dir", str(tmp_path / "cache"),
+    ])
+    harness.run(cfg, record_in_file=False)
+    found = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(prof)
+        for f in fs
+    ]
+    assert found, f"no profiler artifacts under {prof}"
