@@ -418,19 +418,14 @@ def gm2(
 
     Non-finite rows are EXCLUDED (weight 0): the XLA path selects their
     contributions to 0 per iteration (the select fuses into the reduction —
-    no persistent sanitized copy at large d); the pallas path runs on the
-    zeroed stack once — a zeroed row contributes nothing to ``num`` and
-    exactly ``1/max(clamp, |g|)`` to ``den``, which is subtracted back out,
-    so the fused kernel needs no mask plumbing.
+    no persistent sanitized copy at large d); the fused pallas kernel masks
+    them in-tile (VPU ops on resident data, no extra HBM traffic).
     """
     finite = _finite_rows(wmatrix)
     init_guess = _finite_centroid(wmatrix, finite) if guess is None else guess
     use_pallas = impl == "pallas" and pallas_kernels.supports_fused(
         wmatrix.shape[1]
     )
-    if use_pallas:
-        w_san = _mask_rows(wmatrix, finite)  # small-d regime only
-        n_bad = jnp.sum(~finite).astype(jnp.float32)
 
     def cond(state):
         i, _, movement = state
@@ -439,8 +434,7 @@ def gm2(
     def body(state):
         i, g, _ = state
         if use_pallas:
-            num, den = pallas_kernels.weiszfeld_step(w_san, g)
-            den = den - n_bad / jnp.maximum(DIST_CLAMP, jnp.linalg.norm(g))
+            num, den = pallas_kernels.weiszfeld_step(wmatrix, g)
         else:
             dist = _weiszfeld_dists(wmatrix, g)
             inv = jnp.where(finite, 1.0 / dist, 0.0)
@@ -488,17 +482,13 @@ def gm(
     identical RNG stream.
 
     Non-finite rows are EXCLUDED (they transmit nothing): the XLA path
-    zeroes their messages via the masked inverse distance; the pallas path
-    runs on the zeroed stack and subtracts the zeroed rows' analytic
-    denominator contribution ``gain0 * scaler / max(clamp, |g|)`` (their
-    numerator term is exactly 0).
+    zeroes their messages via the masked inverse distance; the fused pallas
+    kernel masks them in-tile.
     """
     finite = _finite_rows(wmatrix)
     init_guess = _finite_centroid(wmatrix, finite) if guess is None else guess
     k_clients, d = wmatrix.shape
     use_pallas = impl == "pallas" and pallas_kernels.supports_fused(d)
-    if use_pallas:
-        w_san = _mask_rows(wmatrix, finite)  # small-d regime only
 
     def cond(state):
         i, _, movement, _ = state
@@ -511,20 +501,8 @@ def gm(
         if use_pallas:
             key_h, key_n = jax.random.split(sub)
             h_r, h_i = channel.rayleigh_fade(key_h, k_clients)
-            h_sq = h_r**2 + h_i**2
             num, den = pallas_kernels.aircomp_weiszfeld_step(
-                w_san, g, h_sq, scaler, p_max=p_max
-            )
-            # analytic contribution of a zeroed row (message [0.., scaler/d0]
-            # with d0 = max(clamp, |g|)), removed so exclusion is exact
-            inv0 = 1.0 / jnp.maximum(DIST_CLAMP, jnp.linalg.norm(g))
-            p_msg0 = inv0**2 * scaler**2 / (d + 1.0) / h_sq
-            gain0 = jnp.sqrt(
-                p_max
-                / jnp.maximum(p_msg0, GM_THRESHOLD_FACTOR * scaler**2)
-            )
-            den = den - jnp.sum(
-                jnp.where(finite, 0.0, gain0 * inv0 * scaler)
+                wmatrix, g, h_r**2 + h_i**2, scaler, p_max=p_max
             )
             if noise_var is not None:
                 scale = jnp.sqrt(jnp.asarray(noise_var, jnp.float32) / 2.0)

@@ -91,12 +91,18 @@ def _weiszfeld_kernel(k_actual, tk, w_ref, g_ref, num_ref, den_ref):
         den_ref[0, 0] = 0.0
 
     w = w_ref[:]  # [TK, Dp] — the only HBM read of this tile
+    # non-finite rows are EXCLUDED (weight 0) — a point at infinity; the
+    # mask costs only VPU ops on the resident tile, matching the XLA
+    # path's exclusion (ops.aggregators._finite_rows) with no extra HBM
+    # traffic.  The select on w stops 0*Inf = NaN in the sums.
+    finite = jnp.all(jnp.isfinite(w), axis=1, keepdims=True)  # [TK, 1]
+    w = jnp.where(finite, w, 0.0)
     diff = w - g_ref[:]
     sq = jnp.sum(diff * diff, axis=1, keepdims=True)  # [TK, 1]
     dist = jnp.maximum(jnp.sqrt(sq), DIST_CLAMP)
     inv = 1.0 / dist
     row = i * tk + jax.lax.broadcasted_iota(jnp.int32, (tk, 1), 0)
-    inv = jnp.where(row < k_actual, inv, 0.0)
+    inv = jnp.where(jnp.logical_and(row < k_actual, finite), inv, 0.0)
     num_ref[:] += jnp.sum(w * inv, axis=0, keepdims=True)
     den_ref[0, 0] += jnp.sum(inv)
 
@@ -149,6 +155,10 @@ def _aircomp_kernel(
     scaler = sc_ref[0]
     threshold = GM_THRESHOLD_FACTOR * scaler * scaler
     w = w_ref[:]  # [TK, Dp] — single HBM read
+    # exclude non-finite rows in-tile (they transmit nothing), matching the
+    # XLA path's masked inverse distance — see _weiszfeld_kernel
+    finite = jnp.all(jnp.isfinite(w), axis=1, keepdims=True)  # [TK, 1]
+    w = jnp.where(finite, w, 0.0)
     diff = w - g_ref[:]
     sq_dist = jnp.sum(diff * diff, axis=1, keepdims=True)  # [TK, 1]
     sq_norm = jnp.sum(w * w, axis=1, keepdims=True)  # [TK, 1]
@@ -163,7 +173,9 @@ def _aircomp_kernel(
     gain = jnp.sqrt(p_max / jnp.maximum(p_message, threshold))  # [TK, 1]
 
     row = i * tk + jax.lax.broadcasted_iota(jnp.int32, (tk, 1), 0)
-    coeff = jnp.where(row < k_actual, gain * inv, 0.0)  # [TK, 1]
+    coeff = jnp.where(
+        jnp.logical_and(row < k_actual, finite), gain * inv, 0.0
+    )  # [TK, 1]
     num_ref[:] += jnp.sum(w * coeff, axis=0, keepdims=True)
     den_ref[0, 0] += jnp.sum(coeff) * scaler
 

@@ -101,8 +101,8 @@ def test_trainer_runs_with_pallas_impl():
 
 
 def test_gm2_pallas_excludes_nonfinite_rows_like_xla():
-    # the pallas path runs on the zeroed stack and subtracts the zeroed
-    # rows' denominator term; both impls must agree on the exclusion
+    # the fused kernel masks non-finite rows in-tile (weight 0); both
+    # impls must agree on the exclusion
     import numpy as np
 
     from byzantine_aircomp_tpu.ops import aggregators as agg
