@@ -23,6 +23,7 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..cli import add_knob_flags
 from ..fed.config import FedConfig
 from ..fed.train import FedTrainer
 from ..registry import AGGREGATORS, ATTACKS
@@ -44,6 +45,15 @@ def run_cell(
     kw["attack"] = attack
     if attack is None:
         kw["byz_size"] = 0  # reference semantics (run(), :430-431)
+    # per-cell knob sanitization, so one global knob set can cover a mixed
+    # matrix: attack_param only reaches attacks that take one, and krum_m
+    # is clamped when the byz-zeroed 'none' cell shrinks node_size below it
+    if kw.get("attack_param") is not None:
+        spec = ATTACKS.get(attack) if attack is not None else None
+        if spec is None or spec.param_name is None:
+            kw["attack_param"] = None
+    if kw.get("krum_m") is not None:
+        kw["krum_m"] = min(kw["krum_m"], kw["honest_size"] + kw["byz_size"])
     cfg = FedConfig(**kw)
     trainer = FedTrainer(cfg, dataset=dataset)
     # the single-round program is shape-independent, so round 0 both warms
@@ -71,12 +81,17 @@ def run_sweep(
     dataset=None,
     log=lambda s: print(s, file=sys.stderr, flush=True),
     on_cell=None,
+    seeds: int = 1,
 ) -> Dict[Tuple[str, Optional[str]], Dict[str, float]]:
     """The full matrix; dataset is loaded once and shared across cells.
     ``on_cell(agg, attack, metrics)`` fires as each cell completes, so
-    callers can stream results and a late-cell crash loses nothing."""
+    callers can stream results and a late-cell crash loses nothing.
+    ``seeds > 1`` repeats each cell at consecutive seeds and reports the
+    mean, plus ``val_acc_std`` across seeds."""
     from ..data import datasets as data_lib
 
+    if seeds < 1:
+        raise ValueError(f"seeds must be >= 1, got {seeds}")
     for a in aggs:
         AGGREGATORS.get(a)  # fail fast on typos, before any training
     for t in attacks:
@@ -84,10 +99,24 @@ def run_sweep(
             ATTACKS.get(t)
     if dataset is None:
         dataset = data_lib.load(cfg_kw.get("dataset", "mnist"))
+    base_seed = cfg_kw.get("seed", 2021)
     grid: Dict[Tuple[str, Optional[str]], Dict[str, float]] = {}
     for attack in attacks:
         for agg in aggs:
-            cell = run_cell(agg, attack, cfg_kw, dataset)
+            runs = []
+            for s in range(seeds):
+                kw = dict(cfg_kw, seed=base_seed + s)
+                runs.append(run_cell(agg, attack, kw, dataset))
+            cell = {
+                k: round(sum(r[k] for r in runs) / len(runs), 4)
+                for k in runs[0]
+            }
+            if seeds > 1:
+                accs = [r["val_acc"] for r in runs]
+                mu = sum(accs) / len(accs)
+                cell["val_acc_std"] = round(
+                    (sum((a - mu) ** 2 for a in accs) / len(accs)) ** 0.5, 4
+                )
             grid[(agg, attack)] = cell
             log(f"[sweep] agg={agg} attack={attack}: {cell}")
             if on_cell is not None:
@@ -123,6 +152,10 @@ def main(argv=None) -> None:
     ap.add_argument("--gamma", type=float, default=1e-2)
     ap.add_argument("--var", type=float, default=None)
     ap.add_argument("--seed", type=int, default=2021)
+    ap.add_argument("--seeds", type=int, default=1,
+                    help="repeat each cell at N consecutive seeds; reports "
+                         "the mean (+ val_acc_std)")
+    add_knob_flags(ap)  # shared with the main CLI (incl. help text)
     ap.add_argument("--out", default=None, help="pickle the grid here")
     args = ap.parse_args(argv)
 
@@ -141,11 +174,17 @@ def main(argv=None) -> None:
         noise_var=args.var,
         seed=args.seed,
         eval_train=False,
+        attack_param=args.attack_param,
+        krum_m=args.krum_m,
+        clip_tau=args.clip_tau,
+        clip_iters=args.clip_iters,
+        sign_eta=args.sign_eta,
     )
     grid = run_sweep(
         aggs,
         attacks,
         cfg_kw,
+        seeds=args.seeds,
         on_cell=lambda agg, attack, cell: print(
             json.dumps({"agg": agg, "attack": attack or "none", **cell}),
             flush=True,

@@ -24,6 +24,23 @@ _SHARDING = {"auto": None, "on": True, "off": False}
 # single source of truth: argparse dest -> (FedConfig field, converter).
 # Both the kwargs construction and the preset explicit-override scan derive
 # from this, so the two cannot drift.
+def add_knob_flags(p) -> None:
+    """The attack/defense magnitude knobs, shared between the main CLI and
+    the sweep tool so the two surfaces (and their help text) cannot drift."""
+    p.add_argument("--attack-param", type=float, default=None,
+                   help="scalar attack magnitude (alie z / ipm eps / gaussian "
+                        "sigma / minmax+minsum fixed gamma)")
+    p.add_argument("--krum-m", type=int, default=None,
+                   help="multi-Krum selection count (default: honest size)")
+    p.add_argument("--clip-tau", type=float, default=10.0,
+                   help="centered-clipping radius (agg=cclip)")
+    p.add_argument("--clip-iters", type=int, default=3,
+                   help="centered-clipping iterations (agg=cclip)")
+    p.add_argument("--sign-eta", type=float, default=None,
+                   help="one-bit OTA majority-vote step size (agg=signmv; "
+                        "default: coordinatewise median delta magnitude)")
+
+
 ARG_TO_FIELD = {
     "opt": ("opt", None),
     "agg": ("agg", None),
@@ -108,18 +125,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="client-batch assembly (pallas = fused u8 gather+normalize "
              "kernel; experimental, measure before adopting)",
     )
-    p.add_argument("--attack-param", type=float, default=None,
-                   help="scalar attack magnitude (alie z / ipm eps / gaussian "
-                        "sigma / minmax+minsum fixed gamma)")
-    p.add_argument("--krum-m", type=int, default=None,
-                   help="multi-Krum selection count (default: honest size)")
-    p.add_argument("--clip-tau", type=float, default=10.0,
-                   help="centered-clipping radius (agg=cclip)")
-    p.add_argument("--clip-iters", type=int, default=3,
-                   help="centered-clipping iterations (agg=cclip)")
-    p.add_argument("--sign-eta", type=float, default=None,
-                   help="one-bit OTA majority-vote step size (agg=signmv; "
-                        "default: coordinatewise median delta magnitude)")
+    add_knob_flags(p)
     p.add_argument(
         "--prng-impl",
         choices=["threefry", "rbg", "unsafe_rbg"],

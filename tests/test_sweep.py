@@ -67,3 +67,36 @@ def test_sweep_cli_json_and_pickle(tmp_path):
     with open(out, "rb") as f:
         grid = pickle.load(f)
     assert "mean|none" in grid
+
+
+def test_sweep_multi_seed_reports_std():
+    ds = data_lib.load("mnist", synthetic_train=640, synthetic_val=160)
+    grid = sweep.run_sweep(
+        ["mean"], [None], _cfg_kw(rounds=2), dataset=ds,
+        log=lambda s: None, seeds=2,
+    )
+    cell = grid[("mean", None)]
+    assert "val_acc_std" in cell and cell["val_acc_std"] >= 0.0
+    assert 0.0 <= cell["val_acc"] <= 1.0
+
+
+def test_sweep_knobs_sanitized_per_cell():
+    # a global --attack-param must not crash cells whose attack takes no
+    # parameter, and --krum-m must survive the byz-zeroed 'none' cell
+    ds = data_lib.load("mnist", synthetic_train=640, synthetic_val=160)
+    grid = sweep.run_sweep(
+        ["multi_krum"], [None, "classflip", "alie"],
+        _cfg_kw(attack_param=2.0, krum_m=10),  # K=10 attacked, 8 at none
+        dataset=ds, log=lambda s: None,
+    )
+    assert len(grid) == 3
+    for cell in grid.values():
+        assert np.isfinite(cell["val_loss"])
+
+
+def test_sweep_rejects_bad_seeds():
+    import pytest
+
+    with pytest.raises(ValueError):
+        sweep.run_sweep(["mean"], [None], _cfg_kw(), dataset=object(),
+                        log=lambda s: None, seeds=0)
