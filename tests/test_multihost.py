@@ -17,6 +17,7 @@ import pytest
 _WORKER = r"""
 import sys
 proc_id = int(sys.argv[1]); nprocs = int(sys.argv[2]); port = sys.argv[3]
+agg = sys.argv[4] if len(sys.argv) > 4 else "gm2"
 import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 4)
@@ -29,7 +30,7 @@ from byzantine_aircomp_tpu.parallel import ShardedFedTrainer, mesh as mesh_lib, 
 assert multihost.is_distributed()
 assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
 mesh = mesh_lib.make_mesh(model_parallel=2)
-cfg = FedConfig(honest_size=12, byz_size=4, attack="classflip", agg="gm2",
+cfg = FedConfig(honest_size=12, byz_size=4, attack="classflip", agg=agg,
                 rounds=1, display_interval=2, batch_size=8, eval_train=False,
                 agg_maxiter=10, eval_batch=64)
 ds = data_lib.load("mnist", synthetic_train=512, synthetic_val=128)
@@ -47,7 +48,17 @@ def _free_port():
 
 
 @pytest.mark.slow
-def test_two_process_sharded_round(tmp_path):
+@pytest.mark.parametrize(
+    "agg",
+    [
+        "gm2",
+        # the ppermute ring (collective.ring_krum_scores): its p-1 hops
+        # circulate blocks over DCN across the two processes — the one
+        # collective family the gm2 path never exercises
+        "krum",
+    ],
+)
+def test_two_process_sharded_round(tmp_path, agg):
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)
     port = str(_free_port())
@@ -60,7 +71,7 @@ def test_two_process_sharded_round(tmp_path):
     )
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(i), "2", port],
+            [sys.executable, str(worker), str(i), "2", port, agg],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             env=env,
