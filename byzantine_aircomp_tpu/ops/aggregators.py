@@ -26,7 +26,6 @@ returns one row, like the reference).  All are jit/vmap/shard_map friendly.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
