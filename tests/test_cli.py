@@ -131,3 +131,29 @@ def test_profile_dir_writes_a_trace(tmp_path):
         for f in fs
     ]
     assert found, f"no profiler artifacts under {prof}"
+
+
+def test_run_titles_distinct_across_extension_knobs():
+    # checkpoint/cache paths key on run_title: configs differing in any
+    # framework-extension knob must never collide (the B=5/B=10 collision
+    # in the reproduce pipeline came from exactly this class of gap —
+    # K/B live in the cache filename prefix, everything else must be in
+    # the title)
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+    from byzantine_aircomp_tpu.fed.harness import run_title
+
+    variants = [
+        dict(),
+        dict(local_steps=4),
+        dict(local_steps=4, fedprox_mu=0.1),
+        dict(server_opt="momentum"),
+        dict(server_opt="adam"),
+        dict(noise_var=1e-2),
+        dict(agg="krum"),
+        dict(attack="classflip", byz_size=2),
+        dict(mark="x"),
+    ]
+    titles = [
+        run_title(FedConfig(honest_size=8, **v)) for v in variants
+    ]
+    assert len(set(titles)) == len(titles), titles
