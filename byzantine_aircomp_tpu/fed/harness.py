@@ -49,6 +49,17 @@ def run_title(cfg: FedConfig) -> str:
         title += f"_momentum{cfg.server_lr}m{cfg.server_momentum}"
     elif cfg.server_opt != "none":
         title += f"_{cfg.server_opt}{cfg.server_lr}"
+    # result-affecting magnitude knobs (non-default only, same rationale)
+    if cfg.attack_param is not None:
+        title += f"_ap{cfg.attack_param}"
+    if cfg.krum_m is not None:
+        title += f"_m{cfg.krum_m}"
+    if cfg.clip_tau != 10.0:
+        title += f"_tau{cfg.clip_tau}"
+    if cfg.clip_iters != 3:
+        title += f"_ci{cfg.clip_iters}"
+    if cfg.sign_eta is not None:
+        title += f"_eta{cfg.sign_eta}"
     if cfg.mark:
         title += f"_{cfg.mark}"
     return title

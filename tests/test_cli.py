@@ -152,6 +152,17 @@ def test_run_titles_distinct_across_extension_knobs():
         dict(agg="krum"),
         dict(attack="classflip", byz_size=2),
         dict(mark="x"),
+        # magnitude knobs — every result-affecting knob must reach the title
+        dict(agg="multi_krum", krum_m=3),
+        dict(agg="multi_krum", krum_m=5),
+        dict(agg="multi_krum"),
+        dict(agg="cclip", clip_tau=1.0),
+        dict(agg="cclip", clip_iters=5),
+        dict(agg="cclip"),
+        dict(attack="alie", byz_size=2, attack_param=0.5),
+        dict(attack="alie", byz_size=2),
+        dict(agg="signmv", sign_eta=0.01),
+        dict(agg="signmv"),
     ]
     titles = [
         run_title(FedConfig(honest_size=8, **v)) for v in variants
