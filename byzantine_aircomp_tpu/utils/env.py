@@ -1,0 +1,63 @@
+"""Tunnel-proof environment helpers shared by the driver entry points.
+
+A wedged axon relay blocks JAX backend init indefinitely (every backend,
+because the axon PJRT plugin hooks ``get_backend``).  The one reliable
+bypass is to keep the plugin from booting at all: the axon sitecustomize
+gates its ``register()`` call (the hang site) on ``PALLAS_AXON_POOL_IPS``.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def scrubbed_cpu_env(n_devices: int | None = None) -> dict:
+    """A copy of ``os.environ`` that cannot touch the device tunnel:
+    axon boot disabled, CPU platform forced, optionally ``n_devices``
+    virtual host devices pinned via XLA_FLAGS."""
+    env = dict(os.environ)
+    # sitecustomize gates the PJRT register() call (the hang site when the
+    # tunnel relay is wedged) on this variable — unset disables axon boot
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        parts = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        parts.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(parts)
+    return env
+
+
+def probe_backend_subprocess(timeout: float):
+    """Initialize the default-env JAX backend in a subprocess.
+
+    Returns ``{'backend': str, 'n': int}`` on success, ``None`` if init
+    hung past ``timeout`` or failed — without ever risking the caller's
+    process on a wedged tunnel.
+    """
+    import json
+    import subprocess
+    import sys
+
+    src = (
+        "import jax, json; "
+        "print(json.dumps({'backend': jax.default_backend(), 'n': len(jax.devices())}))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", src],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return None
