@@ -43,17 +43,25 @@ def run_cell(
     kw = dict(cfg_kw)
     kw["agg"] = agg
     kw["attack"] = attack
-    if attack is None:
-        kw["byz_size"] = 0  # reference semantics (run(), :430-431)
     # per-cell knob sanitization, so one global knob set can cover a mixed
     # matrix: attack_param only reaches attacks that take one, and krum_m
-    # is clamped when the byz-zeroed 'none' cell shrinks node_size below it
+    # is clamped when the byz-zeroed 'none' cell shrinks node_size below
+    # it.  Every adjustment is recorded in ``effective`` so the emitted
+    # rows / pickled grid can't misrepresent which knobs a cell actually ran
+    effective: Dict[str, object] = {}
+    if attack is None and kw.get("byz_size"):
+        kw["byz_size"] = 0  # reference semantics (run(), :430-431)
+        effective["byz_size"] = 0
     if kw.get("attack_param") is not None:
         spec = ATTACKS.get(attack) if attack is not None else None
         if spec is None or spec.param_name is None:
             kw["attack_param"] = None
+            effective["attack_param"] = None  # dropped: attack takes no knob
     if kw.get("krum_m") is not None:
-        kw["krum_m"] = min(kw["krum_m"], kw["honest_size"] + kw["byz_size"])
+        clamped = min(kw["krum_m"], kw["honest_size"] + kw["byz_size"])
+        if clamped != kw["krum_m"]:
+            effective["krum_m"] = clamped
+        kw["krum_m"] = clamped
     cfg = FedConfig(**kw)
     trainer = FedTrainer(cfg, dataset=dataset)
     # the single-round program is shape-independent, so round 0 both warms
@@ -71,6 +79,8 @@ def run_cell(
         metrics["rounds_per_sec"] = round((cfg.rounds - 1) / dt, 3)
     loss, acc = trainer.evaluate("val")
     metrics.update(val_acc=round(acc, 4), val_loss=round(loss, 4))
+    if effective:
+        metrics["effective"] = effective
     return metrics
 
 
@@ -110,7 +120,10 @@ def run_sweep(
             cell = {
                 k: round(sum(r[k] for r in runs) / len(runs), 4)
                 for k in runs[0]
+                if isinstance(runs[0][k], (int, float))
             }
+            if "effective" in runs[0]:  # same sanitization at every seed
+                cell["effective"] = runs[0]["effective"]
             if seeds > 1:
                 accs = [r["val_acc"] for r in runs]
                 mu = sum(accs) / len(accs)

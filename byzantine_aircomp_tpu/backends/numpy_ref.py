@@ -197,6 +197,10 @@ def sign_majority_vote(
     if sign_eta is None:
         absd = np.where(finite, np.abs(delta), np.inf)
         eta = np.sort(absd, axis=0)[(len(w) - 1) // 2]
+        # mirror the jax path: an Inf median (>= ceil(K/2) non-finite
+        # deltas, outside the B < K/2 contract) degrades to a no-op step
+        # rather than Inf * sign(0) = NaN on tied votes
+        eta = np.where(np.isfinite(eta), eta, 0.0)
     else:
         eta = np.float32(sign_eta)
     return (guess + eta * np.sign(votes)).astype(np.float32)
@@ -205,11 +209,14 @@ def sign_majority_vote(
 def centered_clip(
     w: np.ndarray,
     guess: Optional[np.ndarray] = None,
-    clip_tau: float = 10.0,
+    clip_tau: Optional[float] = None,
     clip_iters: int = 3,
 ) -> np.ndarray:
     """Oracle for the framework's centered-clipping aggregator (an
-    extension; Karimireddy et al. 2021): v += mean(clip(w_i - v, tau))."""
+    extension; Karimireddy et al. 2021): v += mean(clip(w_i - v, tau)).
+    ``clip_tau=None`` = adaptive per-step tau: the LOWER-MIDDLE median of
+    the client delta norms (non-finite rows counted as +Inf, Inf median
+    degraded to 0), matching the jax path."""
     w, finite = _exclude_nonfinite_rows(w)
     if guess is None:
         v = w.sum(axis=0) / max(finite.sum(), 1)
@@ -218,7 +225,13 @@ def centered_clip(
     for _ in range(clip_iters):
         delta = np.where(finite[:, None], w - v[None, :], 0.0)
         norms = np.maximum(np.linalg.norm(delta, axis=1), 1e-12)
-        scale = np.minimum(1.0, clip_tau / norms)
+        if clip_tau is None:
+            srt = np.sort(np.where(finite, norms, np.inf))
+            tau = srt[(len(w) - 1) // 2]
+            tau = tau if np.isfinite(tau) else 0.0
+        else:
+            tau = clip_tau
+        scale = np.minimum(1.0, tau / norms)
         v = v + (delta * scale[:, None]).mean(axis=0)
     return v.astype(np.float32)
 

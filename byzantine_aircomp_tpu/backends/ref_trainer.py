@@ -1,13 +1,21 @@
-"""``--backend=ref``: a NumPy oracle training loop (MLP only).
+"""``--backend=ref``: a NumPy oracle training loop (MLP and CNN).
 
 The north star keeps a non-JAX reference path behind the same CLI so the TPU
 backend can be validated end-to-end ("matching CPU-reference test accuracy
 within 0.5%").  This is a loop-style NumPy transcription of the reference's
-``SGD`` round loop (``/root/reference/MNIST_Air_weight.py:226-372``) for the
-linear MLP model: per-client manual softmax-regression gradients, the same
-attack/channel/aggregation order, the same contiguous sharding and
-with-replacement sampling.  Deliberately simple and slow — it exists to be
-obviously correct.
+``SGD`` round loop (``/root/reference/MNIST_Air_weight.py:226-372``): per
+client manual gradients, the same attack/channel/aggregation order, the same
+contiguous sharding and with-replacement sampling.  Deliberately simple and
+slow — it exists to be obviously correct.
+
+Models: the linear MLP (softmax regression, reference ``:53-62``) and the
+CNN (conv5x5/32 + pool -> conv5x5/64 + pool -> fc -> fc, reference
+``:63-90``) as explicit im2col NumPy forward/backward.  The CNN's flat
+parameter layout matches the flax pytree leaf order (alphabetical:
+Conv_0/bias, Conv_0/kernel, Conv_1/bias, Conv_1/kernel, Dense_0/bias,
+Dense_0/kernel, Dense_1/bias, Dense_1/kernel — see ``ops.flatten``), so
+``tests/test_parity.py`` can assert gradient-level agreement against
+``jax.grad`` on identical flat vectors, not just end-accuracy parity.
 """
 
 from __future__ import annotations
@@ -33,37 +41,213 @@ def _ce_loss(logits, y):
     return -np.log(np.maximum(p[np.arange(len(y)), y], 1e-12))
 
 
-def _init_mlp(rng: np.random.Generator, d_in: int, n_cls: int):
-    # xavier-normal with relu gain, bias 0.01 (reference :92-95)
-    std = np.sqrt(2.0) * np.sqrt(2.0 / (d_in + n_cls))
-    w = rng.normal(0.0, std, (d_in, n_cls)).astype(np.float32)
-    b = np.full((n_cls,), 0.01, np.float32)
-    return np.concatenate([w.reshape(-1), b])
+def _xavier_normal_relu(rng, shape, fan_in, fan_out):
+    # xavier-normal with relu gain (reference weights_init, :92-95)
+    std = np.sqrt(2.0) * np.sqrt(2.0 / (fan_in + fan_out))
+    return rng.normal(0.0, std, shape).astype(np.float32)
 
 
-def _grad(flat, x, y, d_in, n_cls):
-    w = flat[: d_in * n_cls].reshape(d_in, n_cls)
-    b = flat[d_in * n_cls :]
-    logits = x @ w + b
-    delta = _softmax(logits)
-    delta[np.arange(len(y)), y] -= 1.0
-    delta /= len(y)
-    gw = x.T @ delta
-    gb = delta.sum(axis=0)
-    return np.concatenate([gw.reshape(-1), gb])
+class _NumpyMLP:
+    """Softmax regression (reference MLP, :53-62): flat = [w.ravel(), b]."""
+
+    def __init__(self, d_in: int, n_cls: int):
+        self.d_in, self.n_cls = d_in, n_cls
+
+    def prepare(self, x):
+        return x.reshape(len(x), -1)
+
+    def init(self, rng) -> np.ndarray:
+        w = _xavier_normal_relu(rng, (self.d_in, self.n_cls), self.d_in, self.n_cls)
+        b = np.full((self.n_cls,), 0.01, np.float32)
+        return np.concatenate([w.reshape(-1), b])
+
+    def _unpack(self, flat):
+        cut = self.d_in * self.n_cls
+        return flat[:cut].reshape(self.d_in, self.n_cls), flat[cut:]
+
+    def logits(self, flat, x):
+        w, b = self._unpack(flat)
+        return x @ w + b
+
+    def grad(self, flat, x, y):
+        w, b = self._unpack(flat)
+        delta = _softmax(x @ w + b)
+        delta[np.arange(len(y)), y] -= 1.0
+        delta /= len(y)
+        return np.concatenate([(x.T @ delta).reshape(-1), delta.sum(axis=0)])
 
 
-def _eval(flat, x, y, d_in, n_cls):
-    w = flat[: d_in * n_cls].reshape(d_in, n_cls)
-    b = flat[d_in * n_cls :]
-    logits = x @ w + b
-    loss = float(_ce_loss(logits, y).mean())
-    acc = float((logits.argmax(axis=1) == y).mean())
-    return loss, acc
+def _im2col(x: np.ndarray, kh: int, kw: int, pad: int) -> np.ndarray:
+    """[B,H,W,C] -> [B,H,W,kh*kw*C] patches (stride 1, SAME-style pad),
+    ordered (h, w, c) to match a flax [kh,kw,C,F] kernel reshaped to
+    [kh*kw*C, F]."""
+    b, h, w, c = x.shape
+    xp = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    win = np.lib.stride_tricks.sliding_window_view(xp, (kh, kw), axis=(1, 2))
+    # win: [B, H, W, C, kh, kw] -> [B, H, W, kh, kw, C]
+    win = win.transpose(0, 1, 2, 4, 5, 3)
+    return win.reshape(b, h, w, kh * kw * c)
+
+
+def _col2im(g_patches: np.ndarray, shape, kh: int, kw: int, pad: int) -> np.ndarray:
+    """Adjoint of :func:`_im2col`: scatter-add patch gradients back."""
+    b, h, w, c = shape
+    gp = g_patches.reshape(b, h, w, kh, kw, c)
+    out = np.zeros((b, h + 2 * pad, w + 2 * pad, c), np.float32)
+    for i in range(kh):
+        for j in range(kw):
+            out[:, i : i + h, j : j + w, :] += gp[:, :, :, i, j, :]
+    return out[:, pad : pad + h, pad : pad + w, :]
+
+
+def _maxpool2(x: np.ndarray):
+    """2x2/2 max pool on [B,H,W,C]; returns (pooled, argmax mask).
+
+    Ties (e.g. relu-zeroed windows) keep the FIRST max in row-major window
+    order, matching XLA select_and_scatter."""
+    b, h, w, c = x.shape
+    win = x.reshape(b, h // 2, 2, w // 2, 2, c)
+    pooled = win.max(axis=(2, 4))
+    eq = win == pooled[:, :, None, :, None, :]  # [b,h2,i,w2,j,c]
+    eqf = eq.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4, c)
+    first = (np.cumsum(eqf, axis=3) == 1) & eqf  # first True along (i,j)
+    mask = first.reshape(b, h // 2, w // 2, 2, 2, c).transpose(0, 1, 3, 2, 4, 5)
+    return pooled, mask
+
+
+def _maxpool2_back(g: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    b, hh, _, ww, _, c = mask.shape
+    return (mask * g[:, :, None, :, None, :]).reshape(b, hh * 2, ww * 2, c)
+
+
+class _NumpyCNN:
+    """Reference CNN (:63-90) in explicit im2col NumPy, NHWC.
+
+    Flat layout mirrors the flax FlatSpec leaf order (dict keys sorted):
+    b1, k1[5,5,C,32], b2, k2[5,5,32,64], fb1, fk1[fc_in,W], fb2, fk2[W,n]."""
+
+    def __init__(self, h: int, w: int, c_in: int, n_cls: int, fc_width: int):
+        assert h % 4 == 0 and w % 4 == 0, "two 2x2 pools need H, W % 4 == 0"
+        self.h, self.w, self.c_in = h, w, c_in
+        self.n_cls, self.fc_width = n_cls, fc_width
+        self.fc_in = (h // 4) * (w // 4) * 64
+        shapes = [
+            (32,), (5, 5, c_in, 32),
+            (64,), (5, 5, 32, 64),
+            (fc_width,), (self.fc_in, fc_width),
+            (n_cls,), (fc_width, n_cls),
+        ]
+        self.shapes = shapes
+        self.sizes = [int(np.prod(s)) for s in shapes]
+        self.offsets = np.cumsum([0] + self.sizes[:-1]).tolist()
+
+    def prepare(self, x):
+        return x if x.ndim == 4 else x[..., None]
+
+    def init(self, rng) -> np.ndarray:
+        c = self.c_in
+        parts = [
+            np.full((32,), 0.01, np.float32),
+            _xavier_normal_relu(rng, (5, 5, c, 32), 25 * c, 25 * 32),
+            np.full((64,), 0.01, np.float32),
+            _xavier_normal_relu(rng, (5, 5, 32, 64), 25 * 32, 25 * 64),
+            np.full((self.fc_width,), 0.01, np.float32),
+            _xavier_normal_relu(
+                rng, (self.fc_in, self.fc_width), self.fc_in, self.fc_width
+            ),
+            np.full((self.n_cls,), 0.01, np.float32),
+            _xavier_normal_relu(
+                rng, (self.fc_width, self.n_cls), self.fc_width, self.n_cls
+            ),
+        ]
+        return np.concatenate([p.reshape(-1) for p in parts])
+
+    def _unpack(self, flat):
+        return [
+            flat[o : o + s].reshape(shape)
+            for o, s, shape in zip(self.offsets, self.sizes, self.shapes)
+        ]
+
+    def _forward(self, flat, x):
+        b1, k1, b2, k2, fb1, fk1, fb2, fk2 = self._unpack(flat)
+        b = len(x)
+        p1 = _im2col(x, 5, 5, 2)  # [B,H,W,25C]
+        z1 = p1 @ k1.reshape(-1, 32) + b1
+        a1 = np.maximum(z1, 0.0)
+        q1, m1 = _maxpool2(a1)
+        p2 = _im2col(q1, 5, 5, 2)
+        z2 = p2 @ k2.reshape(-1, 64) + b2
+        a2 = np.maximum(z2, 0.0)
+        q2, m2 = _maxpool2(a2)
+        f = q2.reshape(b, -1)
+        z3 = f @ fk1 + fb1
+        a3 = np.maximum(z3, 0.0)
+        logits = a3 @ fk2 + fb2
+        cache = (x, p1, z1, q1, m1, p2, z2, m2, q2, f, z3, a3)
+        return logits, cache
+
+    def logits(self, flat, x):
+        return self._forward(flat, x)[0]
+
+    def grad(self, flat, x, y):
+        _, k1, _, k2, _, fk1, _, fk2 = self._unpack(flat)
+        logits, cache = self._forward(flat, x)
+        x_, p1, z1, q1, m1, p2, z2, m2, q2, f, z3, a3 = cache
+        n = len(y)
+        delta = _softmax(logits)
+        delta[np.arange(n), y] -= 1.0
+        delta /= n  # dL/dlogits, mean CE
+        g_fk2 = a3.T @ delta
+        g_fb2 = delta.sum(axis=0)
+        g_a3 = delta @ fk2.T
+        g_z3 = g_a3 * (z3 > 0)
+        g_fk1 = f.T @ g_z3
+        g_fb1 = g_z3.sum(axis=0)
+        g_f = g_z3 @ fk1.T
+        g_q2 = g_f.reshape(q2.shape)
+        g_a2 = _maxpool2_back(g_q2, m2)
+        g_z2 = g_a2 * (z2 > 0)
+        g_k2 = p2.reshape(-1, p2.shape[-1]).T @ g_z2.reshape(-1, 64)
+        g_b2 = g_z2.sum(axis=(0, 1, 2))
+        g_p2 = g_z2 @ k2.reshape(-1, 64).T
+        g_q1 = _col2im(g_p2, q1.shape, 5, 5, 2)
+        g_a1 = _maxpool2_back(g_q1, m1)
+        g_z1 = g_a1 * (z1 > 0)
+        g_k1 = p1.reshape(-1, p1.shape[-1]).T @ g_z1.reshape(-1, 32)
+        g_b1 = g_z1.sum(axis=(0, 1, 2))
+        parts = [
+            g_b1, g_k1.reshape(5, 5, self.c_in, 32),
+            g_b2, g_k2.reshape(5, 5, 32, 64),
+            g_fb1, g_fk1, g_fb2, g_fk2,
+        ]
+        return np.concatenate([p.reshape(-1) for p in parts]).astype(np.float32)
+
+
+def _make_model(cfg: FedConfig, ds) -> object:
+    sample = ds.x_train[:1]
+    n_cls = ds.num_classes
+    if cfg.model == "MLP":
+        return _NumpyMLP(int(np.prod(sample.shape[1:])), n_cls)
+    if cfg.model in ("CNN", "cnn"):
+        if sample.ndim == 3:
+            h, w, c = sample.shape[1], sample.shape[2], 1
+        else:
+            h, w, c = sample.shape[1], sample.shape[2], sample.shape[3]
+        return _NumpyCNN(h, w, c, n_cls, cfg.fc_width)
+    raise KeyError(f"ref backend: unknown model {cfg.model!r} (MLP or CNN)")
+
+
+def _eval_model(model, flat, x, y, batch: int = 1024):
+    losses, correct = 0.0, 0
+    for lo in range(0, len(x), batch):
+        xb, yb = x[lo : lo + batch], y[lo : lo + batch]
+        logits = model.logits(flat, xb)
+        losses += float(_ce_loss(logits, yb).sum())
+        correct += int((logits.argmax(axis=1) == yb).sum())
+    return losses / len(x), correct / len(x)
 
 
 def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
-    assert cfg.model == "MLP", "ref backend implements the MLP path only"
     if cfg.local_steps != 1 or cfg.server_opt != "none" or cfg.fedprox_mu:
         raise NotImplementedError(
             "ref backend implements the reference's FedSGD only "
@@ -92,20 +276,20 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
 
     ds = dataset if dataset is not None else data_lib.load(cfg.dataset)
     n_cls = ds.num_classes
-    x_tr = ds.x_train.reshape(len(ds.x_train), -1)
+    model = _make_model(cfg, ds)
+    x_tr = model.prepare(ds.x_train)
     y_tr = ds.y_train
-    x_va = ds.x_val.reshape(len(ds.x_val), -1)
+    x_va = model.prepare(ds.x_val)
     y_va = ds.y_val
-    d_in = x_tr.shape[1]
 
     k = cfg.node_size
     shards = data_lib.contiguous_shards(len(x_tr), k)
 
     rng = np.random.default_rng(cfg.seed)
-    flat = _init_mlp(rng, d_in, n_cls)
+    flat = model.init(rng)
 
-    tr = _eval(flat, x_tr, y_tr, d_in, n_cls) if cfg.eval_train else (0.0, 0.0)
-    va = _eval(flat, x_va, y_va, d_in, n_cls)
+    tr = _eval_model(model, flat, x_tr, y_tr) if cfg.eval_train else (0.0, 0.0)
+    va = _eval_model(model, flat, x_va, y_va)
     paths: Dict[str, List[float]] = {
         "trainLossPath": [tr[0]],
         "trainAccPath": [tr[1]],
@@ -129,7 +313,7 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
                     yb = (n_cls - 1) - yb
                 elif node >= byz0 and cfg.attack == "dataflip":
                     xb = 1.0 - xb
-                g = _grad(flat, xb, yb, d_in, n_cls)
+                g = model.grad(flat, xb, yb)
                 if node >= byz0 and cfg.attack == "gradascent":
                     g = -g
                 w_stack[node] = flat - cfg.gamma * (g + cfg.weight_decay * flat)
@@ -206,8 +390,8 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
         variance = float(((w_h - w_h.mean(axis=0)) ** 2).sum(axis=1).mean())
         dt = time.perf_counter() - t0
 
-        tr = _eval(flat, x_tr, y_tr, d_in, n_cls) if cfg.eval_train else (0.0, 0.0)
-        va = _eval(flat, x_va, y_va, d_in, n_cls)
+        tr = _eval_model(model, flat, x_tr, y_tr) if cfg.eval_train else (0.0, 0.0)
+        va = _eval_model(model, flat, x_va, y_va)
         paths["trainLossPath"].append(tr[0])
         paths["trainAccPath"].append(tr[1])
         paths["valLossPath"].append(va[0])

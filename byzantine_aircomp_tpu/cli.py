@@ -32,8 +32,9 @@ def add_knob_flags(p) -> None:
                         "sigma / minmax+minsum fixed gamma)")
     p.add_argument("--krum-m", type=int, default=None,
                    help="multi-Krum selection count (default: honest size)")
-    p.add_argument("--clip-tau", type=float, default=10.0,
-                   help="centered-clipping radius (agg=cclip)")
+    p.add_argument("--clip-tau", type=float, default=None,
+                   help="centered-clipping radius (agg=cclip); default: "
+                        "adaptive per-step median client delta norm")
     p.add_argument("--clip-iters", type=int, default=3,
                    help="centered-clipping iterations (agg=cclip)")
     p.add_argument("--sign-eta", type=float, default=None,

@@ -57,7 +57,11 @@ class FedConfig:
     # scalar magnitude for parameterized message attacks (alie z, ipm eps,
     # gaussian sigma); None = the attack's own default
     attack_param: Optional[float] = None
-    clip_tau: float = 10.0
+    # centered-clipping radius; None = adaptive (per-step median of the
+    # client delta norms, a robust honest-scale estimate for B < K/2).  A
+    # fixed radius that is large vs the honest delta scale collapses under
+    # weightflip — the adaptive default tracks the actual update magnitude
+    clip_tau: Optional[float] = None
     clip_iters: int = 3
     # signmv (one-bit OTA majority vote) step magnitude; None = the
     # coordinatewise median of |w_i - guess| (robust adaptive scale)
@@ -135,8 +139,8 @@ class FedConfig:
         assert self.krum_m is None or 1 <= self.krum_m <= self.node_size, (
             f"krum_m must be in [1, K={self.node_size}], got {self.krum_m}"
         )
-        assert self.clip_tau > 0 and self.clip_iters >= 1, (
-            f"clip_tau must be > 0 and clip_iters >= 1, "
+        assert (self.clip_tau is None or self.clip_tau > 0) and self.clip_iters >= 1, (
+            f"clip_tau must be > 0 (or None = adaptive) and clip_iters >= 1, "
             f"got {self.clip_tau}, {self.clip_iters}"
         )
         assert self.sign_eta is None or self.sign_eta > 0, (

@@ -11,6 +11,7 @@ same record keys including the ``variencePath`` spelling (``:481-489``).
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import os
 import pickle
 import sys
@@ -33,15 +34,24 @@ def log(*k, **kw):
     sys.stdout.flush()
 
 
+_CFG_DEFAULTS = {f.name: f.default for f in dataclasses.fields(FedConfig)}
+
+
+def _non_default(cfg: FedConfig, name: str) -> bool:
+    return getattr(cfg, name) != _CFG_DEFAULTS[name]
+
+
 def run_title(cfg: FedConfig) -> str:
     attack_name = cfg.attack if cfg.attack is not None else "baseline"
     title = f"{cfg.model}_{cfg.opt}_{attack_name}_{cfg.agg}"
     if cfg.noise_var is not None:
         title += f"_{cfg.noise_var}"
     # framework extensions beyond the reference scheme (:446-455) append
-    # only when non-default, so reference-equivalent runs keep identical
-    # titles AND differently-configured runs never collide on checkpoints
-    if cfg.local_steps != 1:
+    # only when non-default (checked against the FedConfig dataclass
+    # defaults, so the two can't drift), so reference-equivalent runs keep
+    # identical titles AND differently-configured runs never collide on
+    # checkpoints
+    if _non_default(cfg, "local_steps"):
         title += f"_E{cfg.local_steps}"
     if cfg.fedprox_mu:
         title += f"_prox{cfg.fedprox_mu}"
@@ -54,9 +64,9 @@ def run_title(cfg: FedConfig) -> str:
         title += f"_ap{cfg.attack_param}"
     if cfg.krum_m is not None:
         title += f"_m{cfg.krum_m}"
-    if cfg.clip_tau != 10.0:
+    if _non_default(cfg, "clip_tau"):
         title += f"_tau{cfg.clip_tau}"
-    if cfg.clip_iters != 3:
+    if _non_default(cfg, "clip_iters"):
         title += f"_ci{cfg.clip_iters}"
     if cfg.sign_eta is not None:
         title += f"_eta{cfg.sign_eta}"

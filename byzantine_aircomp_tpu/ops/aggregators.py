@@ -234,6 +234,11 @@ def sign_majority_vote(
         votes = jnp.sum(jnp.where(finite, jnp.sign(delta), 0.0), axis=0) + n
         if sign_eta is None:
             eta = median(jnp.where(finite, jnp.abs(delta), jnp.inf))
+            # a coordinate where >= ceil(K/2) deltas are non-finite medians
+            # to Inf, and Inf * sign(0) on a tied vote would poison the
+            # params with NaN; outside the B < K/2 contract degrade to a
+            # no-op step there instead
+            eta = jnp.where(jnp.isfinite(eta), eta, 0.0)
         else:
             eta = jnp.float32(sign_eta)
         return g + eta * jnp.sign(votes)
@@ -248,7 +253,7 @@ def centered_clip(
     wmatrix: jnp.ndarray,
     *,
     guess: Optional[jnp.ndarray] = None,
-    clip_tau: float = 10.0,
+    clip_tau: Optional[float] = None,
     clip_iters: int = 3,
     **_,
 ) -> jnp.ndarray:
@@ -257,22 +262,35 @@ def centered_clip(
     from the pre-round global params (the ``guess`` every aggregator already
     receives, reference ``:349-350``), each of the ``clip_iters`` fixed
     steps moves the center by the mean of the client deltas clipped to
-    radius ``clip_tau``:
+    radius tau:
 
         v <- v + mean_i( (w_i - v) * min(1, tau / ||w_i - v||) )
 
+    ``clip_tau=None`` (the default) resolves tau PER STEP to the median of
+    the client delta norms — a robust honest-scale estimate for B < K/2, so
+    the radius tracks the actual update magnitude instead of relying on a
+    hand-tuned constant (a fixed tau large vs the honest delta scale, e.g.
+    the textbook tau=10 against one-local-SGD-step deltas of norm ~1e-2,
+    admits enough of a weightflip row per step to collapse training).
+    Non-finite rows count as +Inf for that median and are excluded from the
+    vote (their delta selected to 0; tau/Inf*Inf would otherwise inject
+    NaN); an Inf median (contract violation) degrades to a no-op step.
+
     A single Byzantine row can displace the center by at most tau/K per
     step, whatever its magnitude.  The fixed small iteration count keeps the
-    program static (no data-dependent while_loop needed at this cost).
-    Non-finite rows are excluded (their delta selected to 0 — a zero vote;
-    tau/Inf*Inf would otherwise inject NaN)."""
+    program static (no data-dependent while_loop needed at this cost)."""
     finite = _finite_rows(wmatrix)
     v = _finite_centroid(wmatrix, finite) if guess is None else guess
 
     def step(v, _):
         delta = jnp.where(finite[:, None], wmatrix - v[None, :], 0.0)
         norms = jnp.maximum(jnp.linalg.norm(delta, axis=1), 1e-12)
-        scale = jnp.minimum(1.0, clip_tau / norms)
+        if clip_tau is None:
+            tau = median(jnp.where(finite, norms, jnp.inf)[:, None])[0]
+            tau = jnp.where(jnp.isfinite(tau), tau, 0.0)
+        else:
+            tau = jnp.float32(clip_tau)
+        scale = jnp.minimum(1.0, tau / norms)
         return v + jnp.mean(delta * scale[:, None], axis=0), None
 
     v, _ = jax.lax.scan(step, v, None, length=clip_iters)

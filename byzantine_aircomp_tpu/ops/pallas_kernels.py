@@ -236,7 +236,10 @@ def gather_normalize(
     (r_total,) = idx.shape
     rt = rows_per_step
     rp = _round_up(r_total, rt)
-    idx_p = jnp.pad(idx, (0, rp - r_total)).astype(jnp.int32)
+    # pad with the LAST valid index, not 0: every tail slot still issues a
+    # row DMA, and repeating the final row keeps those fetches on a line
+    # already in flight instead of dragging row 0 back from HBM
+    idx_p = jnp.pad(idx, (0, rp - r_total), mode="edge").astype(jnp.int32)
     interp = _use_interpret() if interpret is None else interpret
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -59,6 +59,21 @@ def test_synthetic_mnist_properties():
     np.testing.assert_array_equal(ds.x_train, ds2.x_train)
 
 
+def test_mnist_hard_label_noise_caps_accuracy():
+    # the hard variant injects symmetric label noise p=0.09 so the Bayes
+    # accuracy is pinned at 1 - p*9/10 = 0.919 (docs/RESULTS.md matrix set);
+    # same pixels as the plain synthetic set, ~9% of labels flipped
+    hard = data.load("mnist_hard", synthetic_train=4000, synthetic_val=1000)
+    assert hard.source == "synthetic" and hard.num_classes == 10
+    plain = data.load("mnist", synthetic_train=4000, synthetic_val=1000)
+    np.testing.assert_array_equal(hard.x_train, plain.x_train)
+    flipped = float(np.mean(hard.y_train != plain.y_train))
+    assert 0.06 < flipped < 0.12, flipped
+    # deterministic
+    hard2 = data.load("mnist_hard", synthetic_train=4000, synthetic_val=1000)
+    np.testing.assert_array_equal(hard.y_train, hard2.y_train)
+
+
 def test_synthetic_emnist_and_cifar():
     ds = data.load("emnist", synthetic_train=1000, synthetic_val=200)
     assert ds.num_classes == 62 and ds.x_train.shape[1:] == (28, 28)

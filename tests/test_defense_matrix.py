@@ -53,7 +53,7 @@ def test_every_aggregator_survives_every_attack(agg_name, attack_name):
         tol=1e-5,
         impl="xla",
         m=None,
-        clip_tau=10.0,
+        clip_tau=None,
         clip_iters=3,
         sign_eta=None,
     )
@@ -84,7 +84,7 @@ def test_every_aggregator_survives_an_overflowed_row(agg_name):
             tol=1e-5,
             impl="xla",
             m=None,
-            clip_tau=10.0,
+            clip_tau=None,
             clip_iters=3,
             sign_eta=None,
         )

@@ -164,6 +164,20 @@ def test_cclip_knobs_reach_aggregator():
     assert loose["valAccPath"][-1] > tight["valAccPath"][-1] + 0.1
 
 
+def test_cclip_adaptive_default_survives_weightflip():
+    # round-1 verdict: the old fixed tau=10 default collapsed to 0.10 acc
+    # under the textbook weightflip attack (one admitted Byzantine step per
+    # round dwarfs the ~1e-2-norm honest deltas).  The adaptive default
+    # (per-step median delta norm) must track the honest scale and train
+    robust = run_short(
+        make_cfg(honest_size=7, byz_size=3, attack="weightflip", agg="cclip")
+    )
+    clean = run_short(make_cfg(agg="cclip"))
+    assert robust["valAccPath"][-1] > 0.55, robust["valAccPath"]
+    # and stays within reach of its own attack-free trajectory
+    assert robust["valAccPath"][-1] > clean["valAccPath"][-1] - 0.25
+
+
 def test_krum_m_reaches_aggregator():
     a = run_short(make_cfg(agg="multi_krum", rounds=1, seed=3))
     b = run_short(make_cfg(agg="multi_krum", krum_m=1, rounds=1, seed=3))
