@@ -126,14 +126,18 @@ def run_child() -> None:
 # parent: probe + dispatch (no jax import, cannot hang on backend init)
 # --------------------------------------------------------------------------
 
-def _probe_backend(timeout: float):
-    """Returns {'backend':..,'n':..} or None if init hung/failed."""
+def _probe_backend(timeout: float | None):
+    """Returns {'backend':..,'n':..} or None if init hung/failed.
+
+    ``timeout=None`` (BENCH_WATCHDOG_SECS=0 / BENCH_PROBE_SECS=0) waits
+    indefinitely — the documented watchdog-disable contract."""
     from byzantine_aircomp_tpu.utils.env import probe_backend_subprocess
 
     t0 = time.perf_counter()
     info = probe_backend_subprocess(timeout)
     if info is None:
-        log(f"probe: backend init blocked or failed within {timeout:.0f}s — tunnel wedged?")
+        desc = "no limit" if timeout is None else f"{timeout:.0f}s"
+        log(f"probe: backend init blocked or failed within {desc} — tunnel wedged?")
         return None
     log(f"probe: backend={info['backend']} devices={info['n']} init={time.perf_counter() - t0:.1f}s")
     return info
@@ -180,13 +184,14 @@ def main() -> None:
         v = float(os.environ.get(name, os.environ.get("BENCH_WATCHDOG_SECS", default)))
         return None if v == 0 else v
 
-    probe_secs = _secs("BENCH_PROBE_SECS", "120") or 120.0
+    probe_secs = _secs("BENCH_PROBE_SECS", "120")
     run_secs = _secs("BENCH_RUN_SECS", "600")
     cpu_secs = _secs("BENCH_CPU_SECS", "420")
     timed = int(os.environ.get("BENCH_TIMED_ROUNDS", "50"))
     cpu_timed = int(os.environ.get("BENCH_CPU_TIMED_ROUNDS", "10"))
 
-    log(f"probing device backend (timeout {probe_secs:.0f}s)")
+    probe_desc = "disabled" if probe_secs is None else f"{probe_secs:.0f}s"
+    log(f"probing device backend (timeout {probe_desc})")
     info = _probe_backend(probe_secs)
 
     error = None
@@ -196,7 +201,7 @@ def main() -> None:
         if result is None:
             error = f"accelerator bench failed on backend={info['backend']}; cpu fallback"
     elif info is None:
-        error = f"tunnel-wedged: backend init did not complete in {probe_secs:.0f}s; cpu fallback"
+        error = f"tunnel-wedged: backend init did not complete in {probe_desc}; cpu fallback"
     else:
         error = "no accelerator visible (cpu-only env); cpu fallback"
 

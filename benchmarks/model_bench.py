@@ -105,6 +105,15 @@ def main() -> None:
     )
     ap.add_argument("--warmup-rounds", type=int, default=2)
     ap.add_argument("--timed-rounds", type=int, default=10)
+    ap.add_argument(
+        "--K",
+        type=int,
+        default=None,
+        help="scale the rung's client count (honest = K - B); for running "
+        "a rung on hardware the preset's full K does not fit or is too "
+        "slow for (e.g. CPU-labeled fallback numbers)",
+    )
+    ap.add_argument("--B", type=int, default=None)
     args = ap.parse_args()
 
     # same wedged-tunnel watchdog idea as bench.py: abort instead of
@@ -140,6 +149,21 @@ def main() -> None:
     )
     for preset, overrides in rungs:
         _rearm()
+        if args.K is not None:
+            if args.B is not None:
+                b = args.B
+            else:
+                # keep the rung's Byzantine FRACTION: --K 100 on a
+                # K=1000/B=100 rung benches B=10, not a silently
+                # attack-free run wearing the attack-labeled metric name
+                from byzantine_aircomp_tpu import presets as _presets
+
+                spec = {**_presets.PRESETS[preset], **overrides}
+                k0 = spec.get("honest_size", 0) + spec.get("byz_size", 0)
+                b = round(args.K * spec.get("byz_size", 0) / k0) if k0 else 0
+            overrides = {
+                **overrides, "honest_size": args.K - b, "byz_size": b,
+            }
         result = bench_config(
             preset, overrides, args.warmup_rounds, args.timed_rounds
         )

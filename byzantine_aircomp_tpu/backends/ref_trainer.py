@@ -10,12 +10,15 @@ slow — it exists to be obviously correct.
 
 Models: the linear MLP (softmax regression, reference ``:53-62``) and the
 CNN (conv5x5/32 + pool -> conv5x5/64 + pool -> fc -> fc, reference
-``:63-90``) as explicit im2col NumPy forward/backward.  The CNN's flat
-parameter layout matches the flax pytree leaf order (alphabetical:
+``:63-90``) as explicit im2col NumPy forward/backward.  Both models' flat
+parameter layouts match the flax pytree leaf order (alphabetical:
 Conv_0/bias, Conv_0/kernel, Conv_1/bias, Conv_1/kernel, Dense_0/bias,
-Dense_0/kernel, Dense_1/bias, Dense_1/kernel — see ``ops.flatten``), so
-``tests/test_parity.py`` can assert gradient-level agreement against
-``jax.grad`` on identical flat vectors, not just end-accuracy parity.
+Dense_0/kernel, Dense_1/bias, Dense_1/kernel — see ``ops.flatten``).
+Gradient-level agreement against ``jax.grad`` on identical flat vectors is
+asserted by ``tests/test_parity.py::test_mlp_oracle_grad_matches_jax_grad``
+and ``::test_cnn_oracle_grad_matches_jax_grad`` (plus the full 28x28
+MNIST-shape variant), and ``::test_cnn_ref_backend_end_to_end`` covers the
+CNN training loop end to end.
 """
 
 from __future__ import annotations
@@ -48,7 +51,9 @@ def _xavier_normal_relu(rng, shape, fan_in, fan_out):
 
 
 class _NumpyMLP:
-    """Softmax regression (reference MLP, :53-62): flat = [w.ravel(), b]."""
+    """Softmax regression (reference MLP, :53-62): flat = [b, w.ravel()],
+    the flax FlatSpec leaf order (alphabetical: Dense_0/bias, Dense_0/kernel)
+    so oracle and JAX gradients compare on the SAME flat vector."""
 
     def __init__(self, d_in: int, n_cls: int):
         self.d_in, self.n_cls = d_in, n_cls
@@ -59,11 +64,11 @@ class _NumpyMLP:
     def init(self, rng) -> np.ndarray:
         w = _xavier_normal_relu(rng, (self.d_in, self.n_cls), self.d_in, self.n_cls)
         b = np.full((self.n_cls,), 0.01, np.float32)
-        return np.concatenate([w.reshape(-1), b])
+        return np.concatenate([b, w.reshape(-1)])
 
     def _unpack(self, flat):
-        cut = self.d_in * self.n_cls
-        return flat[:cut].reshape(self.d_in, self.n_cls), flat[cut:]
+        n = self.n_cls
+        return flat[n:].reshape(self.d_in, n), flat[:n]
 
     def logits(self, flat, x):
         w, b = self._unpack(flat)
@@ -74,7 +79,7 @@ class _NumpyMLP:
         delta = _softmax(x @ w + b)
         delta[np.arange(len(y)), y] -= 1.0
         delta /= len(y)
-        return np.concatenate([(x.T @ delta).reshape(-1), delta.sum(axis=0)])
+        return np.concatenate([delta.sum(axis=0), (x.T @ delta).reshape(-1)])
 
 
 def _im2col(x: np.ndarray, kh: int, kw: int, pad: int) -> np.ndarray:
@@ -248,13 +253,6 @@ def _eval_model(model, flat, x, y, batch: int = 1024):
 
 
 def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
-    if cfg.local_steps != 1 or cfg.server_opt != "none" or cfg.fedprox_mu:
-        raise NotImplementedError(
-            "ref backend implements the reference's FedSGD only "
-            "(local_steps=1, server_opt=none, fedprox_mu=0); got "
-            f"local_steps={cfg.local_steps}, server_opt={cfg.server_opt!r}, "
-            f"fedprox_mu={cfg.fedprox_mu}"
-        )
     if cfg.attack is None:
         cfg.byz_size = 0
     cfg.validate()
@@ -300,6 +298,13 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
     }
     log_fn(f"[ref backend] round 0: val loss={va[0]:.4f} acc={va[1]:.4f}")
 
+    # FedOpt server state (mirrors fed/train.py's optax transforms exactly:
+    # sgd-with-trace momentum, adam with bias correction, over the
+    # pseudo-gradient delta = w_round_start - aggregate)
+    server_m = np.zeros_like(flat)
+    server_v = np.zeros_like(flat)
+    server_t = 0
+
     byz0 = cfg.honest_size  # Byzantine clients are the last byz_size rows
     for r in range(cfg.rounds):
         t0 = time.perf_counter()
@@ -307,16 +312,25 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
             w_stack = np.empty((k, flat.size), np.float32)
             for node in range(k):
                 lo = shards.offsets[node]
-                idx = lo + rng.integers(0, shards.sizes[node], cfg.batch_size)
-                xb, yb = x_tr[idx], y_tr[idx]
-                if node >= byz0 and cfg.attack == "classflip":
-                    yb = (n_cls - 1) - yb
-                elif node >= byz0 and cfg.attack == "dataflip":
-                    xb = 1.0 - xb
-                g = model.grad(flat, xb, yb)
-                if node >= byz0 and cfg.attack == "gradascent":
-                    g = -g
-                w_stack[node] = flat - cfg.gamma * (g + cfg.weight_decay * flat)
+                # local_steps > 1 = FedAvg regime (fed/train.py
+                # _per_client_weights): E local SGD steps, each on a fresh
+                # with-replacement batch; data/gradient attacks apply at
+                # every local step; fedprox pulls toward the round start
+                w_c = flat
+                for _e in range(cfg.local_steps):
+                    idx = lo + rng.integers(0, shards.sizes[node], cfg.batch_size)
+                    xb, yb = x_tr[idx], y_tr[idx]
+                    if node >= byz0 and cfg.attack == "classflip":
+                        yb = (n_cls - 1) - yb
+                    elif node >= byz0 and cfg.attack == "dataflip":
+                        xb = 1.0 - xb
+                    g = model.grad(w_c, xb, yb)
+                    if node >= byz0 and cfg.attack == "gradascent":
+                        g = -g
+                    if cfg.fedprox_mu:
+                        g = g + cfg.fedprox_mu * (w_c - flat)
+                    w_c = w_c - cfg.gamma * (g + cfg.weight_decay * w_c)
+                w_stack[node] = w_c
 
             if cfg.attack == "weightflip" and cfg.byz_size:
                 w_stack = numpy_ref.weightflip(w_stack, cfg.byz_size)
@@ -348,7 +362,7 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
                 w_stack = numpy_ref.oma(rng, w_stack, cfg.noise_var)
 
             if cfg.agg == "gm":
-                flat = numpy_ref.gm(
+                agg_out = numpy_ref.gm(
                     rng,
                     w_stack,
                     noise_var=cfg.noise_var,
@@ -358,33 +372,54 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
                     p_max=cfg.gm_p_max,
                 ).astype(np.float32)
             elif cfg.agg == "gm2":
-                flat = numpy_ref.gm2(
+                agg_out = numpy_ref.gm2(
                     w_stack, guess=flat, maxiter=cfg.agg_maxiter, tol=cfg.agg_tol
                 ).astype(np.float32)
             elif cfg.agg == "mean":
-                flat = numpy_ref.mean(w_stack)
+                agg_out = numpy_ref.mean(w_stack)
             elif cfg.agg == "median":
-                flat = numpy_ref.median(w_stack)
+                agg_out = numpy_ref.median(w_stack)
             elif cfg.agg == "trimmed_mean":
-                flat = numpy_ref.trimmed_mean(w_stack)
+                agg_out = numpy_ref.trimmed_mean(w_stack)
             elif cfg.agg in ("krum", "Krum"):
-                flat = numpy_ref.krum(w_stack, cfg.honest_size).copy()
+                agg_out = numpy_ref.krum(w_stack, cfg.honest_size).copy()
             elif cfg.agg == "multi_krum":
-                flat = numpy_ref.multi_krum(w_stack, cfg.honest_size, m=cfg.krum_m)
+                agg_out = numpy_ref.multi_krum(w_stack, cfg.honest_size, m=cfg.krum_m)
             elif cfg.agg == "bulyan":
-                flat = numpy_ref.bulyan(w_stack, cfg.honest_size)
+                agg_out = numpy_ref.bulyan(w_stack, cfg.honest_size)
             elif cfg.agg == "cclip":
-                flat = numpy_ref.centered_clip(
+                agg_out = numpy_ref.centered_clip(
                     w_stack, guess=flat,
                     clip_tau=cfg.clip_tau, clip_iters=cfg.clip_iters,
                 )
             elif cfg.agg == "signmv":
-                flat = numpy_ref.sign_majority_vote(
+                agg_out = numpy_ref.sign_majority_vote(
                     w_stack, guess=flat, noise_var=cfg.noise_var,
                     sign_eta=cfg.sign_eta, rng=rng,
                 )
             else:
                 raise KeyError(f"ref backend: unknown aggregator {cfg.agg!r}")
+
+            # server optimizer over the pseudo-gradient (FedAvgM / FedAdam;
+            # fed/train.py:331-339 with optax.sgd(momentum)/optax.adam)
+            if cfg.server_opt == "momentum":
+                delta = flat - agg_out
+                # optax trace: m <- delta + beta * m; update = -lr * m
+                server_m = delta + cfg.server_momentum * server_m
+                flat = (flat - cfg.server_lr * server_m).astype(np.float32)
+            elif cfg.server_opt == "adam":
+                delta = flat - agg_out
+                server_t += 1
+                b1, b2, eps = 0.9, 0.999, 1e-8
+                server_m = b1 * server_m + (1.0 - b1) * delta
+                server_v = b2 * server_v + (1.0 - b2) * delta * delta
+                mhat = server_m / (1.0 - b1**server_t)
+                vhat = server_v / (1.0 - b2**server_t)
+                flat = (
+                    flat - cfg.server_lr * mhat / (np.sqrt(vhat) + eps)
+                ).astype(np.float32)
+            else:  # "none": take the aggregate (reference :354-358)
+                flat = agg_out
 
         w_h = w_stack[: cfg.honest_size]
         variance = float(((w_h - w_h.mean(axis=0)) ** 2).sum(axis=1).mean())

@@ -144,10 +144,13 @@ def _synthetic_images(
     normalization so the raw-u8 and normalized-f32 views agree exactly, like
     real 8-bit datasets.
 
-    ``label_noise`` = probability a sample's label is replaced by a uniform
-    random OTHER class (train and val alike), which pins the Bayes-optimal
-    accuracy at 1 - p*(C-1)/C regardless of model capacity — the knob behind
-    the ``*_hard`` variants."""
+    ``label_noise`` = probability a sample's label is resampled uniformly over
+    ALL C classes (train and val alike; the draw may land on the original
+    class), which pins the Bayes-optimal accuracy at exactly
+    1 - p*(C-1)/C regardless of model capacity — the knob behind the
+    ``*_hard`` variants.  (Flipping to a uniform *other* class would give the
+    different ceiling 1 - p; we use the all-classes form so the documented
+    formula is exact.)"""
     num_classes = len(protos)
     y = rng.integers(0, num_classes, size=n).astype(np.int32)
     x = protos[y] + 0.35 * rng.standard_normal((n,) + shape).astype(np.float32)
@@ -155,7 +158,7 @@ def _synthetic_images(
         flip = rng.random(n) < label_noise
         y = np.where(
             flip,
-            (y + rng.integers(1, num_classes, size=n)) % num_classes,
+            rng.integers(0, num_classes, size=n),
             y,
         ).astype(np.int32)
     u8 = np.round(np.clip(x, 0.0, 1.0) * 255.0).astype(np.uint8)
@@ -220,8 +223,9 @@ def mnist_hard(synthetic_train: int = 60000, synthetic_val: int = 10000, **_) ->
 
     The plain synthetic fallback is separable enough that strong models hit
     0.99+, where a robustness matrix cannot discriminate defenses (several
-    round-1 cells saturated at 1.0000).  Symmetric label noise p=0.09 pins
-    the Bayes-optimal val accuracy at 1 - p*9/10 = 0.919 — the real-MNIST
+    round-1 cells saturated at 1.0000).  Uniform label resampling with
+    p=0.09 (over all 10 classes, so the formula is exact) pins the
+    Bayes-optimal val accuracy at 1 - p*9/10 = 0.919 — the real-MNIST
     paper figure's operating point (draw.ipynb cell 1, final acc ~0.92) —
     so every defense must pay for what it admits and no cell can sit at
     ceiling.  Used by the docs/RESULTS.md sweep; never loads from disk."""
@@ -261,16 +265,38 @@ def emnist(synthetic_train: int = 100000, synthetic_val: int = 16000, **_) -> Da
     )
 
 
+def _read_cifar_bin(path: str):
+    """CIFAR-10 binary batch -> (images [N,3,32,32] u8, labels [N] u8).
+
+    Native C++ parser first (``native/dataio.cpp``), pure-NumPy row parse as
+    the fallback so the binary distribution loads even without a compiler
+    (record layout: 1 label byte + 3072 CHW pixel bytes per row)."""
+    out = native_io.read_cifar_bin(path)
+    if out is not None:
+        return out
+    try:
+        raw = np.fromfile(path, np.uint8)
+    except OSError:
+        return None
+    if raw.size == 0 or raw.size % 3073:
+        return None
+    rows = raw.reshape(-1, 3073)
+    return (
+        np.ascontiguousarray(rows[:, 1:]).reshape(-1, 3, 32, 32),
+        np.ascontiguousarray(rows[:, 0]),
+    )
+
+
 def _cifar10_from_bin() -> Optional[Dataset]:
-    """CIFAR-10 from the binary-batch distribution via the native parser."""
+    """CIFAR-10 from the binary-batch distribution."""
     root = _find_dir("cifar-10-batches-bin")
     if root is None:
         return None
     train = [
-        native_io.read_cifar_bin(os.path.join(root, f"data_batch_{i}.bin"))
+        _read_cifar_bin(os.path.join(root, f"data_batch_{i}.bin"))
         for i in range(1, 6)
     ]
-    test = native_io.read_cifar_bin(os.path.join(root, "test_batch.bin"))
+    test = _read_cifar_bin(os.path.join(root, "test_batch.bin"))
     if test is None or any(p is None for p in train):
         return None
     x_tr = np.concatenate([p[0] for p in train]).transpose(0, 2, 3, 1)

@@ -66,6 +66,13 @@ def run_title(cfg: FedConfig) -> str:
         title += f"_m{cfg.krum_m}"
     if _non_default(cfg, "clip_tau"):
         title += f"_tau{cfg.clip_tau}"
+    elif cfg.agg == "cclip":
+        # the cclip default changed fixed tau=10 -> adaptive (round 2); pre-
+        # round-2 cclip runs carry the bare title for fixed tau=10, so the
+        # adaptive default must be spelled out or the two algorithms would
+        # alias on checkpoints/pickles and --inherit would silently resume a
+        # fixed-tau checkpoint under the adaptive rule
+        title += "_tauadaptive"
     if _non_default(cfg, "clip_iters"):
         title += f"_ci{cfg.clip_iters}"
     if cfg.sign_eta is not None:

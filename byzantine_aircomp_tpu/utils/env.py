@@ -31,12 +31,12 @@ def scrubbed_cpu_env(n_devices: int | None = None) -> dict:
     return env
 
 
-def probe_backend_subprocess(timeout: float):
+def probe_backend_subprocess(timeout: float | None):
     """Initialize the default-env JAX backend in a subprocess.
 
     Returns ``{'backend': str, 'n': int}`` on success, ``None`` if init
-    hung past ``timeout`` or failed — without ever risking the caller's
-    process on a wedged tunnel.
+    hung past ``timeout`` (``None`` = wait indefinitely) or failed —
+    without ever risking the caller's process on a wedged tunnel.
     """
     import json
     import subprocess

@@ -15,6 +15,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# tests (and every subprocess they spawn — sweep CLI, multihost workers)
+# must never touch the device tunnel: the axon sitecustomize gates its PJRT
+# register() on this variable, and register() hangs indefinitely when the
+# relay is wedged (observed: the sweep-CLI subprocess test timing out at
+# 600s with the child stuck inside `import jax`)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax  # noqa: E402
 
@@ -29,3 +35,29 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running integration tests (multi-process, presets)"
     )
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow",
+        action="store_true",
+        default=False,
+        help="also run slow-marked tests (the full tier)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Two test tiers (judge r2 item 4: the full suite's ~22 min is an
+    iteration-speed tax).  Default = quick tier; the full tier runs with
+    ``pytest tests/ --runslow`` (or ``RUN_SLOW=1``) and before snapshots.
+    Every slow-marked family keeps at least one quick representative."""
+    if config.getoption("--runslow") or os.environ.get("RUN_SLOW", "") not in ("", "0"):
+        return
+    import pytest
+
+    skip = pytest.mark.skip(
+        reason="slow tier: pass --runslow (or RUN_SLOW=1) to include"
+    )
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
