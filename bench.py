@@ -201,7 +201,13 @@ def main() -> None:
         if result is None:
             error = f"accelerator bench failed on backend={info['backend']}; cpu fallback"
     elif info is None:
-        error = f"tunnel-wedged: backend init did not complete in {probe_desc}; cpu fallback"
+        from byzantine_aircomp_tpu.utils.env import diagnose_relay
+
+        relay = diagnose_relay()
+        error = (
+            f"tunnel failure (relay {relay}): backend init did not complete "
+            f"in {probe_desc}; cpu fallback"
+        )
     else:
         error = "no accelerator visible (cpu-only env); cpu fallback"
 

@@ -149,21 +149,20 @@ def main() -> None:
     )
     for preset, overrides in rungs:
         _rearm()
-        if args.K is not None:
+        if args.K is not None or args.B is not None:
+            from byzantine_aircomp_tpu import presets as _presets
+
+            spec = {**_presets.PRESETS[preset], **overrides}
+            k0 = spec.get("honest_size", 0) + spec.get("byz_size", 0)
+            k = args.K if args.K is not None else k0
             if args.B is not None:
                 b = args.B
             else:
                 # keep the rung's Byzantine FRACTION: --K 100 on a
                 # K=1000/B=100 rung benches B=10, not a silently
                 # attack-free run wearing the attack-labeled metric name
-                from byzantine_aircomp_tpu import presets as _presets
-
-                spec = {**_presets.PRESETS[preset], **overrides}
-                k0 = spec.get("honest_size", 0) + spec.get("byz_size", 0)
-                b = round(args.K * spec.get("byz_size", 0) / k0) if k0 else 0
-            overrides = {
-                **overrides, "honest_size": args.K - b, "byz_size": b,
-            }
+                b = round(k * spec.get("byz_size", 0) / k0) if k0 else 0
+            overrides = {**overrides, "honest_size": k - b, "byz_size": b}
         result = bench_config(
             preset, overrides, args.warmup_rounds, args.timed_rounds
         )

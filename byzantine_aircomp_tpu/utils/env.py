@@ -31,6 +31,32 @@ def scrubbed_cpu_env(n_devices: int | None = None) -> dict:
     return env
 
 
+def diagnose_relay(ports=(8082, 8083), timeout: float = 3.0) -> str:
+    """Classify the device-tunnel relay state without touching JAX.
+
+    Returns ``"listening"`` (some relay port accepts connections — a hang is
+    then a WEDGED relay), ``"dead"`` (connection refused everywhere — the
+    relay process is gone and nothing in-container can restart it), or
+    ``"unknown"`` (timeouts/other).  Used to make bench/dryrun artifacts
+    self-describing about WHICH tunnel failure occurred."""
+    import socket
+
+    saw_refused = False
+    for port in ports:
+        s = socket.socket()
+        s.settimeout(timeout)
+        try:
+            s.connect(("127.0.0.1", port))
+            return "listening"
+        except ConnectionRefusedError:
+            saw_refused = True
+        except OSError:
+            pass
+        finally:
+            s.close()
+    return "dead" if saw_refused else "unknown"
+
+
 def probe_backend_subprocess(timeout: float | None):
     """Initialize the default-env JAX backend in a subprocess.
 
