@@ -21,6 +21,11 @@ import time
 import jax
 import jax.numpy as jnp
 
+# runnable as a plain script (`python benchmarks/agg_bench.py`): the
+# package lives in the repo root, one directory up
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def bench_one(fn, args, iters: int):
     jax.block_until_ready(fn(*args))  # compile + sync

@@ -28,6 +28,10 @@ import sys
 import threading
 import time
 
+# runnable as a plain script (`python benchmarks/model_bench.py`): the
+# package lives in the repo root, one directory up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -45,7 +49,9 @@ DEFAULT_RUNGS = [
 ]
 
 
-def bench_config(preset: str, overrides: dict, warmup: int, timed: int) -> dict:
+def bench_config(
+    preset: str, overrides: dict, warmup: int, timed: int, tag: str = ""
+) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -87,11 +93,21 @@ def bench_config(preset: str, overrides: dict, warmup: int, timed: int) -> dict:
         f" (val_loss={loss:.4f} val_acc={acc:.4f})"
     )
     return {
-        "metric": f"fl_rounds_per_sec_{preset}"
-        + (f"_K{k}" if overrides else ""),
+        # tag encodes every CLI scale-down knob so records at different
+        # effective configs/units can never collide under one metric name
+        # (the run-title lesson: differently-configured runs must not alias)
+        "metric": f"fl_rounds_per_sec_{preset}{tag}",
         "value": round(rps, 3),
         "unit": "rounds/sec",
         "val_acc": round(acc, 4),
+        # effective config, so scaled-down (e.g. CPU-labeled) runs are
+        # self-describing instead of borrowing the full-size preset's name
+        "platform": jax.default_backend(),
+        "K": k,
+        "B": cfg.byz_size,
+        "batch_size": cfg.batch_size,
+        "display_interval": cfg.display_interval,
+        "timed_rounds": timed,
     }
 
 
@@ -114,6 +130,21 @@ def main() -> None:
         "slow for (e.g. CPU-labeled fallback numbers)",
     )
     ap.add_argument("--B", type=int, default=None)
+    ap.add_argument(
+        "--batch-size",
+        type=int,
+        default=None,
+        help="override the rung's per-client batch (CPU-labeled scale-down "
+        "runs; the reported config always includes the effective value)",
+    )
+    ap.add_argument(
+        "--interval",
+        type=int,
+        default=None,
+        help="override display_interval (iterations per 'round'); NOTE this "
+        "changes the rounds/sec unit — the record carries the effective "
+        "value so scaled-down numbers stay self-describing",
+    )
     args = ap.parse_args()
 
     # same wedged-tunnel watchdog idea as bench.py: abort instead of
@@ -147,11 +178,17 @@ def main() -> None:
     rungs = (
         [(p, {}) for p in args.preset] if args.preset else DEFAULT_RUNGS
     )
+    from byzantine_aircomp_tpu import presets as _presets
+
     for preset, overrides in rungs:
         _rearm()
+        if preset not in _presets.PRESETS:
+            raise SystemExit(
+                f"model_bench: unknown preset {preset!r}; available: "
+                f"{', '.join(_presets.names())}"
+            )
+        tag = ""
         if args.K is not None or args.B is not None:
-            from byzantine_aircomp_tpu import presets as _presets
-
             spec = {**_presets.PRESETS[preset], **overrides}
             k0 = spec.get("honest_size", 0) + spec.get("byz_size", 0)
             k = args.K if args.K is not None else k0
@@ -162,9 +199,27 @@ def main() -> None:
                 # K=1000/B=100 rung benches B=10, not a silently
                 # attack-free run wearing the attack-labeled metric name
                 b = round(k * spec.get("byz_size", 0) / k0) if k0 else 0
+                if b == 0 and spec.get("byz_size", 0):
+                    # tiny K must not silently drop the attack entirely
+                    b = 1
+                    log(
+                        f"model_bench: K={k} rounds the rung's Byzantine "
+                        "fraction to 0; forcing B=1 so the attack still runs"
+                    )
+            if not 0 <= b < k:
+                raise SystemExit(
+                    f"model_bench: need 0 <= B < K, got K={k} B={b}"
+                )
             overrides = {**overrides, "honest_size": k - b, "byz_size": b}
+            tag += f"_K{k}_B{b}"
+        if args.batch_size is not None:
+            overrides = {**overrides, "batch_size": args.batch_size}
+            tag += f"_bs{args.batch_size}"
+        if args.interval is not None:
+            overrides = {**overrides, "display_interval": args.interval}
+            tag += f"_i{args.interval}"
         result = bench_config(
-            preset, overrides, args.warmup_rounds, args.timed_rounds
+            preset, overrides, args.warmup_rounds, args.timed_rounds, tag=tag
         )
         print(json.dumps(result), flush=True)
     if watchdog is not None:
