@@ -53,6 +53,11 @@ def main():
 
     from byzantine_aircomp_tpu.ops import aggregators as agg_lib
 
+    if not 0 <= args.byz < args.k:
+        raise SystemExit(
+            f"agg_bench: need 0 <= byz < k, got k={args.k} byz={args.byz} "
+            "(pass --byz explicitly when scaling --k down)"
+        )
     key = jax.random.PRNGKey(0)
     honest = args.k - args.byz
     # realistic stack: tight honest cluster one SGD step apart + byz outliers

@@ -49,9 +49,7 @@ DEFAULT_RUNGS = [
 ]
 
 
-def bench_config(
-    preset: str, overrides: dict, warmup: int, timed: int, tag: str = ""
-) -> dict:
+def bench_config(preset: str, overrides: dict, warmup: int, timed: int) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -65,6 +63,18 @@ def bench_config(
         eval_train=False,
         **overrides,
     )
+    # metric tag = every deviation of the effective config from the preset's
+    # own (whether from CLI flags or a rung's built-in scale-down), so
+    # records at different configs/round units never collide under one
+    # metric name (the run-title lesson)
+    base = presets.get(preset)
+    tag = ""
+    if (cfg.node_size, cfg.byz_size) != (base.node_size, base.byz_size):
+        tag += f"_K{cfg.node_size}_B{cfg.byz_size}"
+    if cfg.batch_size != base.batch_size:
+        tag += f"_bs{cfg.batch_size}"
+    if cfg.display_interval != base.display_interval:
+        tag += f"_i{cfg.display_interval}"
     trainer = _make_trainer(cfg, FedTrainer)
     k = cfg.node_size
     log(
@@ -93,9 +103,6 @@ def bench_config(
         f" (val_loss={loss:.4f} val_acc={acc:.4f})"
     )
     return {
-        # tag encodes every CLI scale-down knob so records at different
-        # effective configs/units can never collide under one metric name
-        # (the run-title lesson: differently-configured runs must not alias)
         "metric": f"fl_rounds_per_sec_{preset}{tag}",
         "value": round(rps, 3),
         "unit": "rounds/sec",
@@ -182,12 +189,12 @@ def main() -> None:
 
     for preset, overrides in rungs:
         _rearm()
-        if preset not in _presets.PRESETS:
-            raise SystemExit(
-                f"model_bench: unknown preset {preset!r}; available: "
-                f"{', '.join(_presets.names())}"
-            )
-        tag = ""
+        try:
+            # fast-fail on a typo'd preset BEFORE any backend work, with
+            # presets.get's canonical available-list message
+            _presets.get(preset)
+        except KeyError as e:
+            raise SystemExit(f"model_bench: {e.args[0]}") from None
         if args.K is not None or args.B is not None:
             spec = {**_presets.PRESETS[preset], **overrides}
             k0 = spec.get("honest_size", 0) + spec.get("byz_size", 0)
@@ -211,15 +218,12 @@ def main() -> None:
                     f"model_bench: need 0 <= B < K, got K={k} B={b}"
                 )
             overrides = {**overrides, "honest_size": k - b, "byz_size": b}
-            tag += f"_K{k}_B{b}"
         if args.batch_size is not None:
             overrides = {**overrides, "batch_size": args.batch_size}
-            tag += f"_bs{args.batch_size}"
         if args.interval is not None:
             overrides = {**overrides, "display_interval": args.interval}
-            tag += f"_i{args.interval}"
         result = bench_config(
-            preset, overrides, args.warmup_rounds, args.timed_rounds, tag=tag
+            preset, overrides, args.warmup_rounds, args.timed_rounds
         )
         print(json.dumps(result), flush=True)
     if watchdog is not None:

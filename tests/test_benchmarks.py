@@ -1,0 +1,56 @@
+"""The benchmark scripts must stay runnable as plain scripts with the
+scale-down flags (docs/PERFORMANCE.md records rungs captured through
+them), and their guardrails must fire before any backend work."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *flags, timeout=600):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", script), *flags],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+    )
+
+
+def test_model_bench_rejects_bad_flags_fast():
+    r = _run("model_bench.py", "--preset", "nope", "--K", "4", timeout=120)
+    assert r.returncode != 0 and "unknown preset" in r.stderr
+    r = _run(
+        "model_bench.py",
+        "--preset", "mnist_mlp_k50_b5_classflip", "--K", "4", "--B", "9",
+        timeout=120,
+    )
+    assert r.returncode != 0 and "need 0 <= B < K" in r.stderr
+
+
+def test_agg_bench_rejects_bad_byz_fast():
+    r = _run("agg_bench.py", "--k", "8", timeout=120)  # default byz=100 > k
+    assert r.returncode != 0 and "need 0 <= byz < k" in r.stderr
+
+
+@pytest.mark.slow
+def test_model_bench_tiny_rung_end_to_end():
+    """A tiny MLP rung through the real CLI: the record must carry the
+    tagged metric name and the full effective config."""
+    r = _run(
+        "model_bench.py",
+        "--preset", "mnist_mlp_k50_b5_classflip",
+        "--K", "8", "--batch-size", "8", "--interval", "2",
+        "--warmup-rounds", "1", "--timed-rounds", "1",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"].endswith("_K8_B1_bs8_i2")
+    assert rec["K"] == 8 and rec["B"] == 1
+    assert rec["batch_size"] == 8 and rec["display_interval"] == 2
+    assert rec["value"] > 0
