@@ -175,6 +175,20 @@ def main() -> None:
             watchdog.daemon = True
             watchdog.start()
 
+    rungs = (
+        [(p, {}) for p in args.preset] if args.preset else DEFAULT_RUNGS
+    )
+    # fast-fail on ANY typo'd preset before backend INIT (the hang site on
+    # a wedged tunnel) and before earlier rungs burn minutes of benchmark;
+    # presets is pure config, touching no backend
+    from byzantine_aircomp_tpu import presets as _presets
+
+    for preset, _ in rungs:
+        try:
+            _presets.get(preset)  # canonical available-list KeyError
+        except KeyError as e:
+            raise SystemExit(f"model_bench: {e.args[0]}") from None
+
     _rearm()  # covers backend init, which hangs first on a wedged tunnel
     import jax
 
@@ -182,19 +196,8 @@ def main() -> None:
         f"model_bench: backend={jax.default_backend()} "
         f"devices={len(jax.devices())}"
     )
-    rungs = (
-        [(p, {}) for p in args.preset] if args.preset else DEFAULT_RUNGS
-    )
-    from byzantine_aircomp_tpu import presets as _presets
-
     for preset, overrides in rungs:
         _rearm()
-        try:
-            # fast-fail on a typo'd preset BEFORE any backend work, with
-            # presets.get's canonical available-list message
-            _presets.get(preset)
-        except KeyError as e:
-            raise SystemExit(f"model_bench: {e.args[0]}") from None
         if args.K is not None or args.B is not None:
             spec = {**_presets.PRESETS[preset], **overrides}
             k0 = spec.get("honest_size", 0) + spec.get("byz_size", 0)

@@ -173,35 +173,46 @@ def test_full_schedule_parity_north_star():
     converges to ~0.920) — on ``mnist_hard``, whose label noise pins the
     Bayes ceiling at 0.919, the paper figure's operating point, so the gate
     is exercised AT the interesting accuracy rather than at a saturated
-    1.0.  Both backends run the identical config; the gate is
-    |Delta final val acc| <= 0.005 with the final accuracy tail-averaged
-    over the last 5 round evals to damp single-eval trajectory jitter.
+    1.0.  Both backends run the identical config at TWO seeds; the gate is
+    |Delta seed-mean final val acc| <= 0.005 with each final accuracy
+    tail-averaged over the last 5 round evals.  Per-seed deltas carry
+    opposite signs here (measured +0.0044 / -0.0021), so the seed mean
+    (~0.0011) gates the systematic backend difference, not seed luck.
     """
     ds = data_lib.load("mnist_hard", synthetic_train=20000, synthetic_val=10000)
-    kw = dict(
-        honest_size=45,
-        byz_size=5,
-        attack="classflip",
-        agg="gm2",
-        rounds=100,
-        display_interval=10,
-        batch_size=50,
-        eval_train=False,
-        # reference caller overrides (MNIST_Air_weight.py:350)
-        agg_maxiter=1000,
-        agg_tol=1e-5,
-    )
-    jax_paths = FedTrainer(FedConfig(**kw), dataset=ds).train()
-    ref_paths = run_ref(FedConfig(**kw), log_fn=lambda *a, **k: None, dataset=ds)
+    per_seed = []
+    for seed in (2021, 2022):
+        kw = dict(
+            honest_size=45,
+            byz_size=5,
+            attack="classflip",
+            agg="gm2",
+            rounds=100,
+            display_interval=10,
+            batch_size=50,
+            eval_train=False,
+            # reference caller overrides (MNIST_Air_weight.py:350)
+            agg_maxiter=1000,
+            agg_tol=1e-5,
+            seed=seed,
+        )
+        jax_paths = FedTrainer(FedConfig(**kw), dataset=ds).train()
+        ref_paths = run_ref(
+            FedConfig(**kw), log_fn=lambda *a, **k: None, dataset=ds
+        )
+        a = float(np.mean(jax_paths["valAccPath"][-5:]))
+        b = float(np.mean(ref_paths["valAccPath"][-5:]))
+        # each seed must converge into the ceiling's neighborhood (0.919)
+        assert a > 0.88 and b > 0.88, (seed, a, b)
+        # and no single seed may diverge grossly even where the mean hides it
+        assert abs(a - b) <= 0.01, (seed, a, b)
+        per_seed.append((a, b))
 
-    a = float(np.mean(jax_paths["valAccPath"][-5:]))
-    b = float(np.mean(ref_paths["valAccPath"][-5:]))
-    # both must converge into the ceiling's neighborhood (Bayes = 0.919)
-    assert a > 0.88 and b > 0.88, (a, b)
-    assert abs(a - b) <= 0.005, (
-        f"north-star 0.5% gate failed: jax={a:.4f} ref={b:.4f} "
-        f"(jax tail {jax_paths['valAccPath'][-5:]}, "
-        f"ref tail {ref_paths['valAccPath'][-5:]})"
+    jax_mean = float(np.mean([a for a, _ in per_seed]))
+    ref_mean = float(np.mean([b for _, b in per_seed]))
+    assert abs(jax_mean - ref_mean) <= 0.005, (
+        f"north-star 0.5% gate failed: jax={jax_mean:.4f} ref={ref_mean:.4f} "
+        f"per-seed={per_seed}"
     )
 
 
