@@ -95,6 +95,40 @@ def test_weightflip_breaks_mean_but_not_gm2():
     )
 
 
+@pytest.mark.slow
+def test_results_matrix_headline_claims():
+    """Executable lock on docs/RESULTS.md's headline claims at its own
+    config (mnist_hard, K=20, B=4, batch 32, 5x10 iterations):
+
+    - trimmed_mean COLLAPSES under weightflip because B=4 exceeds its trim
+      beta = floor(0.1*20) = 2 — the textbook breakdown condition;
+    - the adaptive-tau cclip default SURVIVES the same attack;
+    - krum holds near the honest baseline.
+    """
+    ds = data_lib.load("mnist_hard", synthetic_train=12000, synthetic_val=3000)
+    kw = dict(
+        honest_size=16,
+        byz_size=4,
+        attack="weightflip",
+        rounds=5,
+        display_interval=10,
+        batch_size=32,
+        eval_train=False,
+        agg_maxiter=100,
+    )
+
+    def final(agg):
+        cfg = FedConfig(**{**kw, "agg": agg})
+        return FedTrainer(cfg, dataset=ds).train()["valAccPath"][-1]
+
+    tmean = final("trimmed_mean")
+    cclip = final("cclip")
+    krum = final("krum")
+    assert tmean < 0.3, f"trimmed_mean should break at B > 2*beta: {tmean}"
+    assert cclip > 0.75, f"adaptive cclip should survive weightflip: {cclip}"
+    assert krum > 0.75, f"krum should survive weightflip: {krum}"
+
+
 def test_variance_metric_recorded():
     paths = run_short(make_cfg(rounds=2))
     assert len(paths["variencePath"]) == 2
