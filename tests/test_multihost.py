@@ -1,10 +1,13 @@
-"""Two-process DCN validation: the sharded trainer over a multi-host mesh.
+"""Multi-process DCN validation: the sharded trainer over a multi-host mesh.
 
-Spawns two REAL processes that ``jax.distributed.initialize`` against a
-local coordinator, each contributing 4 virtual CPU devices, and runs one
+Spawns N REAL processes that ``jax.distributed.initialize`` against a
+local coordinator, each contributing 8/N virtual CPU devices, and runs one
 federated round of ``ShardedFedTrainer`` over the global 8-device
-(clients x model) mesh.  Both processes must report identical results —
+(clients x model) mesh.  All processes must report identical results AND
+match a single-process run of the same config on the same logical mesh —
 the framework's answer to "distributed without a cluster" (SURVEY.md §4).
+N=4 routes the ppermute ring's hops across three process boundaries
+instead of one, the closest CPU analog to a multi-host ICI/DCN ring.
 """
 
 import os
@@ -14,13 +17,25 @@ import sys
 
 import pytest
 
+_CFG_KW = dict(
+    honest_size=12,
+    byz_size=4,
+    attack="classflip",
+    rounds=1,
+    display_interval=2,
+    batch_size=8,
+    eval_train=False,
+    agg_maxiter=10,
+    eval_batch=64,
+)
+
 _WORKER = r"""
 import sys
 proc_id = int(sys.argv[1]); nprocs = int(sys.argv[2]); port = sys.argv[3]
 agg = sys.argv[4] if len(sys.argv) > 4 else "gm2"
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+jax.config.update("jax_num_cpu_devices", 8 // nprocs)
 jax.distributed.initialize(coordinator_address=f"localhost:{port}",
                            num_processes=nprocs, process_id=proc_id)
 from byzantine_aircomp_tpu.data import datasets as data_lib
@@ -28,11 +43,11 @@ from byzantine_aircomp_tpu.fed.config import FedConfig
 from byzantine_aircomp_tpu.parallel import ShardedFedTrainer, mesh as mesh_lib, multihost
 
 assert multihost.is_distributed()
-assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+assert len(jax.devices()) == 8 and len(jax.local_devices()) == 8 // nprocs
 mesh = mesh_lib.make_mesh(model_parallel=2)
-cfg = FedConfig(honest_size=12, byz_size=4, attack="classflip", agg=agg,
-                rounds=1, display_interval=2, batch_size=8, eval_train=False,
-                agg_maxiter=10, eval_batch=64)
+cfg = FedConfig(agg=agg, **__CFG_KW__)  # literal injected by the test —
+                                        # keeps the worker import-decoupled
+                                        # from the tests/ directory layout
 ds = data_lib.load("mnist", synthetic_train=512, synthetic_val=128)
 tr = ShardedFedTrainer(cfg, dataset=ds, mesh=mesh)
 tr.run_round(0)
@@ -47,20 +62,45 @@ def _free_port():
         return s.getsockname()[1]
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _single_process_reference(agg):
+    """(val_loss, val_acc) of the SAME config on this process's 8-device
+    mesh; cached per agg — it does not depend on nprocs."""
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+    from byzantine_aircomp_tpu.parallel import ShardedFedTrainer, mesh as mesh_lib
+
+    cfg = FedConfig(agg=agg, **_CFG_KW)
+    ds = data_lib.load("mnist", synthetic_train=512, synthetic_val=128)
+    tr = ShardedFedTrainer(
+        cfg, dataset=ds, mesh=mesh_lib.make_mesh(model_parallel=2)
+    )
+    tr.run_round(0)
+    loss, acc = tr.evaluate("val")
+    return float(loss), float(acc)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize(
-    "agg",
+    "nprocs,agg",
     [
-        "gm2",
+        (2, "gm2"),
         # the ppermute ring (collective.ring_krum_scores): its p-1 hops
-        # circulate blocks over DCN across the two processes — the one
+        # circulate blocks over DCN across process boundaries — the one
         # collective family the gm2 path never exercises
-        "krum",
+        (2, "krum"),
+        # 4 processes x 2 devices: ring hops now cross THREE process
+        # boundaries, and the psum tree spans all four
+        (4, "gm2"),
+        (4, "krum"),
     ],
 )
-def test_two_process_sharded_round(tmp_path, agg):
+def test_multi_process_sharded_round(tmp_path, nprocs, agg):
     worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER)
+    worker.write_text(_WORKER.replace("__CFG_KW__", repr(_CFG_KW)))
     port = str(_free_port())
     env = dict(os.environ)
     # a clean env: the workers set up their own CPU backend
@@ -71,23 +111,33 @@ def test_two_process_sharded_round(tmp_path, agg):
     )
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(i), "2", port, agg],
+            [sys.executable, str(worker), str(i), str(nprocs), port, agg],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             env=env,
             text=True,
         )
-        for i in range(2)
+        for i in range(nprocs)
     ]
+    # drain every worker CONCURRENTLY: a sequential communicate() would
+    # leave later workers' pipes undrained — one chatty worker filling its
+    # 64KB pipe buffer blocks on write, stalls the collective, and drags
+    # the whole ring into the timeout
+    import concurrent.futures
+
     outs = []
     try:
-        for p in procs:
-            out, err = p.communicate(timeout=420)
+        with concurrent.futures.ThreadPoolExecutor(len(procs)) as pool:
+            futures = [
+                pool.submit(p.communicate, timeout=420) for p in procs
+            ]
+            comms = [f.result() for f in futures]
+        for p, (out, err) in zip(procs, comms):
             assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
             outs.append(out)
     finally:
-        # a failed/timed-out worker leaves its peer blocked in the
-        # distributed barrier — always reap both
+        # a failed/timed-out worker leaves its peers blocked in the
+        # distributed barrier — always reap all
         for p in procs:
             if p.poll() is None:
                 p.kill()
@@ -95,5 +145,15 @@ def test_two_process_sharded_round(tmp_path, agg):
     results = [
         line for out in outs for line in out.splitlines() if line.startswith("RESULT")
     ]
-    assert len(results) == 2, f"missing results: {outs}"
-    assert results[0] == results[1], f"processes disagree: {results}"
+    assert len(results) == nprocs, f"missing results: {outs}"
+    assert len(set(results)) == 1, f"processes disagree: {results}"
+
+    # the multi-host trajectory must also MATCH a single-process run of the
+    # same config on the same logical 8-device mesh (this test process's
+    # conftest mesh) — cross-process agreement alone could hide a bug that
+    # shifts every process identically
+    l_ref, a_ref = _single_process_reference(agg)
+    _, l_str, a_str = results[0].split()
+    assert abs(float(l_str) - l_ref) < 5e-4 and abs(float(a_str) - a_ref) < 5e-3, (
+        f"multi-host != single-process: {results[0]} vs {l_ref:.8f} {a_ref:.6f}"
+    )
