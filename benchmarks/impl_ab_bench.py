@@ -1,0 +1,116 @@
+"""In-process A/B benchmark for the experimental implementation knobs.
+
+    python benchmarks/impl_ab_bench.py                  # all variants
+    python benchmarks/impl_ab_bench.py --variants baseline,prng_rbg
+    python benchmarks/impl_ab_bench.py --timed-rounds 30 --blocks 3
+
+Run-to-run variance ACROSS processes on the tunneled chip is +-15%
+(docs/PERFORMANCE.md "Measurement discipline"), so keep-or-delete decisions
+for implementation knobs like ``prng_impl=rbg`` must come from repeated
+timed blocks INSIDE one process — that is exactly what this script does:
+every variant builds its own trainer in the same process, compiles, runs
+two warmup blocks, then reports rounds/sec for each of ``--blocks`` timed
+blocks plus their median.
+
+Config matches bench.py's north-star workload (K=1000, B=100 classflip,
+MNIST MLP, gm2, maxiter=1000/tol=1e-5 per MNIST_Air_weight.py:350).
+Prints one JSON line per variant; progress goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+VARIANTS: dict[str, dict] = {
+    # bench.py's exact configuration (agg_impl auto -> pallas on TPU)
+    "baseline": {},
+    # hardware RNG for the [K, batch] index draw + channel noise
+    "prng_rbg": {"prng_impl": "rbg"},
+    # the XLA Weiszfeld path, for reference (the ladder's 62 r/s rung)
+    "agg_xla": {"agg_impl": "xla"},
+}
+
+
+def bench_variant(name: str, overrides: dict, warmup: int, timed: int, blocks: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+    from byzantine_aircomp_tpu.fed.harness import _make_trainer
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+
+    cfg = FedConfig(
+        honest_size=900,
+        byz_size=100,
+        attack="classflip",
+        agg="gm2",
+        rounds=warmup + (2 + blocks) * timed,
+        display_interval=10,
+        batch_size=50,
+        eval_train=False,
+        agg_maxiter=1000,
+        agg_tol=1e-5,
+        **overrides,
+    )
+    trainer = _make_trainer(cfg, FedTrainer)
+    log(f"{name}: compile + warmup (agg={trainer._agg_impl})")
+    trainer.run_rounds(0, warmup)
+    trainer.run_rounds(warmup, timed)
+    trainer.run_rounds(warmup + timed, timed)
+    float(jnp.sum(trainer.flat_params))
+
+    rates = []
+    for b in range(blocks):
+        start = warmup + (2 + b) * timed
+        t0 = time.perf_counter()
+        trainer.run_rounds(start, timed)
+        float(jnp.sum(trainer.flat_params))  # honest completion barrier
+        dt = time.perf_counter() - t0
+        rates.append(round(timed / dt, 2))
+        log(f"{name}: block {b}: {rates[-1]} rounds/sec")
+
+    return {
+        "metric": f"ab_rounds_per_sec_{name}",
+        "value": statistics.median(rates),
+        "unit": "rounds/sec",
+        "blocks": rates,
+        "platform": jax.default_backend(),
+        "overrides": overrides,
+        "timed_rounds": timed,
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--variants", default=",".join(VARIANTS),
+                   help=f"comma list from: {', '.join(VARIANTS)}")
+    p.add_argument("--warmup-rounds", type=int, default=3)
+    p.add_argument("--timed-rounds", type=int, default=30)
+    p.add_argument("--blocks", type=int, default=3)
+    args = p.parse_args()
+
+    names = [v.strip() for v in args.variants.split(",") if v.strip()]
+    unknown = [v for v in names if v not in VARIANTS]
+    if unknown:
+        p.error(f"unknown variants {unknown}; known: {sorted(VARIANTS)}")
+
+    for name in names:
+        rec = bench_variant(name, VARIANTS[name], args.warmup_rounds,
+                            args.timed_rounds, args.blocks)
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,7 +51,6 @@ ARG_TO_FIELD = {
     "inherit": ("inherit", None),
     "sharding": ("sharded", _SHARDING.get),
     "agg_impl": ("agg_impl", None),
-    "gather_impl": ("gather_impl", None),
     "prng_impl": ("prng_impl", None),
     "attack_param": ("attack_param", None),
     "krum_m": ("krum_m", None),
@@ -118,13 +117,6 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["auto", "xla", "pallas"],
         default="auto",
         help="Weiszfeld step implementation (pallas = fused TPU kernel)",
-    )
-    p.add_argument(
-        "--gather-impl",
-        choices=["xla", "pallas"],
-        default="xla",
-        help="client-batch assembly (pallas = fused u8 gather+normalize "
-             "kernel; experimental, measure before adopting)",
     )
     add_knob_flags(p)
     p.add_argument(

@@ -73,12 +73,6 @@ class FedConfig:
     # the sharded trainer forces xla on multi-device meshes (GSPMD
     # cannot partition pallas_call)
     agg_impl: str = "auto"
-    # "xla" | "pallas": client-batch assembly.  "pallas" fuses the uint8
-    # row gather with the normalize (pallas_kernels.gather_normalize) —
-    # an EXPERIMENT pending TPU measurement (docs/ROADMAP.md item 2), so
-    # the default stays xla; requires raw-u8 train storage, forced back
-    # to xla otherwise (and on sharded meshes)
-    gather_impl: str = "xla"
 
     # determinism
     seed: int = 2021
@@ -132,9 +126,6 @@ class FedConfig:
         assert self.honest_size > 0, "honest_size must be positive"
         assert self.agg_impl in ("auto", "xla", "pallas"), (
             f"agg_impl must be 'auto', 'xla' or 'pallas', got {self.agg_impl!r}"
-        )
-        assert self.gather_impl in ("xla", "pallas"), (
-            f"gather_impl must be 'xla' or 'pallas', got {self.gather_impl!r}"
         )
         assert self.krum_m is None or 1 <= self.krum_m <= self.node_size, (
             f"krum_m must be in [1, K={self.node_size}], got {self.krum_m}"

@@ -153,19 +153,6 @@ class FedTrainer:
         if self._agg_impl == "auto":
             self._agg_impl = "pallas" if jax.default_backend() == "tpu" else "xla"
 
-        # experimental fused u8-gather+normalize (pallas_kernels.
-        # gather_normalize; docs/ROADMAP.md item 2): needs the raw-u8
-        # train storage path; the sharded trainer forces xla (GSPMD
-        # cannot partition pallas_call)
-        self._gather_impl = cfg.gather_impl
-        if self._gather_impl == "pallas" and self._norm_scale is None:
-            self._gather_impl = "xla"
-        # the padded train copy is built LAZILY in _train_x_arg: the
-        # sharded trainer forces gather_impl back to xla after this
-        # constructor runs, and eagerly padding would leave a second
-        # full uint8 train set resident for nothing
-        self._x_train_padded = None
-
         # server optimizer over the pseudo-gradient (FedAvgM / FedAdam);
         # "none" = take the aggregate directly (reference :354-358)
         if cfg.server_opt == "momentum":
@@ -271,27 +258,12 @@ class FedTrainer:
                 k_batch, self.offsets, self.sizes,
                 cfg.local_steps * cfg.batch_size,
             )
-            if self._gather_impl == "pallas":
-                # fused u8 gather + normalize in one pallas pass over the
-                # PADDED train set (x_train arrives padded from the caller)
-                from ..ops import pallas_kernels
-
-                x = pallas_kernels.gather_normalize(
-                    x_train,
-                    idx.reshape(-1),
-                    self._norm_scale_padded,
-                    self._norm_bias_padded,
-                )[:, : self._num_features]
-            else:
-                x = x_train[idx]  # [K, E*B, features] on-device 2D gather
-                if self._norm_scale is not None:
-                    # u8 rows -> normalized floats: same map as the host
-                    # path (datasets._normalize) up to float re-association,
-                    # as one multiply-add post-gather on device
-                    x = (
-                        x.astype(jnp.float32) * self._norm_scale
-                        + self._norm_bias
-                    )
+            x = x_train[idx]  # [K, E*B, features] on-device 2D gather
+            if self._norm_scale is not None:
+                # u8 rows -> normalized floats: same map as the host
+                # path (datasets._normalize) up to float re-association,
+                # as one multiply-add post-gather on device
+                x = x.astype(jnp.float32) * self._norm_scale + self._norm_bias
             shape = (cfg.node_size, cfg.local_steps, cfg.batch_size)
             x = x.reshape(
                 shape + (self._sample_shape if self._spatial_input else (-1,))
@@ -415,27 +387,6 @@ class FedTrainer:
     # ------------------------------------------------------------------
     # host-side driver
 
-    @property
-    def _train_x_arg(self):
-        """The train array threaded into the round program — the padded
-        copy (built on first use) when the fused pallas gather is active."""
-        if self._gather_impl != "pallas":
-            return self.x_train
-        if self._x_train_padded is None:
-            from ..ops import pallas_kernels
-
-            lane = pallas_kernels.LANE
-            pad = -self.x_train.shape[1] % lane
-            self._x_train_padded = jnp.pad(self.x_train, ((0, 0), (0, pad)))
-            self._norm_scale_padded = jnp.pad(self._norm_scale, (0, pad))
-            self._norm_bias_padded = jnp.pad(self._norm_bias, (0, pad))
-            # the unpadded device copy is dead from here on (eval reads the
-            # host-side dataset arrays); free it rather than keeping two
-            # full uint8 train sets in HBM
-            self.x_train.delete()
-            self.x_train = None
-        return self._x_train_padded
-
     def _chunked(self, x: np.ndarray, y: np.ndarray):
         b = self.cfg.eval_batch
         n = len(x)
@@ -471,7 +422,7 @@ class FedTrainer:
         round_key = jax.random.fold_in(self._base_key, round_idx)
         self.flat_params, self.server_opt_state, variance = self._round_fn(
             self.flat_params, self.server_opt_state, round_key,
-            self._train_x_arg, self.y_train,
+            self.x_train, self.y_train,
         )
         return variance
 
@@ -486,7 +437,7 @@ class FedTrainer:
         rounds = jnp.arange(start_round, start_round + num_rounds, dtype=jnp.int32)
         self.flat_params, self.server_opt_state, variances = self._multi_round_fn(
             self.flat_params, self.server_opt_state, rounds,
-            self._train_x_arg, self.y_train,
+            self.x_train, self.y_train,
         )
         return variances
 

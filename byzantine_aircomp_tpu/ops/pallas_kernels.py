@@ -180,91 +180,6 @@ def _aircomp_kernel(
     den_ref[0, 0] += jnp.sum(coeff) * scaler
 
 
-# ---------------------------------------------------------------------------
-# fused uint8 gather + normalize (client-batch assembly)
-
-
-def _gather_norm_kernel(
-    rt, idx_ref, x_hbm, scale_ref, bias_ref, out_ref, row_buf, sems
-):
-    # grid step i assembles rows [i*rt, (i+1)*rt): issue all row DMAs from
-    # HBM, then wait and write the normalized f32 block.  The u8 rows are
-    # read from HBM exactly once and the (u8 -> f32, *scale, +bias) map
-    # happens in VMEM — the XLA path materializes the gathered u8 batch in
-    # HBM and re-reads it for the normalize.
-    i = pl.program_id(0)
-    for r in range(rt):
-        pltpu.make_async_copy(
-            x_hbm.at[idx_ref[i * rt + r]], row_buf.at[r], sems.at[r]
-        ).start()
-    for r in range(rt):
-        pltpu.make_async_copy(
-            x_hbm.at[idx_ref[i * rt + r]], row_buf.at[r], sems.at[r]
-        ).wait()
-    out_ref[:] = (
-        row_buf[:].astype(jnp.float32) * scale_ref[:] + bias_ref[:]
-    )
-
-
-@functools.partial(jax.jit, static_argnames=("rows_per_step", "interpret"))
-def gather_normalize(
-    x_u8: jnp.ndarray,
-    idx: jnp.ndarray,
-    scale: jnp.ndarray,
-    bias: jnp.ndarray,
-    *,
-    rows_per_step: int = 8,
-    interpret=None,
-) -> jnp.ndarray:
-    """Fused ``x_u8[idx].astype(f32) * scale + bias`` over lane-aligned rows.
-
-    ``x_u8`` is the [N, Fp] uint8 train set with Fp a LANE multiple (the
-    trainer pads once at init); ``idx`` a flat [R] int32 row index vector;
-    ``scale``/``bias`` [Fp] per-feature normalization.  Returns [R, Fp] f32.
-    Rows are fetched by per-row DMA driven by scalar-prefetched indices
-    (``PrefetchScalarGridSpec``), ``rows_per_step`` rows per grid step.
-
-    Default-OFF experiment (``gather_impl="pallas"``): the hypothesis —
-    docs/ROADMAP.md item 2 — is that fusing the normalize into the gather
-    saves one HBM round-trip of the gathered batch; whether per-row DMA
-    beats XLA's native gather must be MEASURED before this becomes a
-    default (the tunnel was down when it was written).
-    """
-    n, fp = x_u8.shape
-    if fp % LANE:
-        raise ValueError(f"feature dim {fp} must be a multiple of {LANE}")
-    (r_total,) = idx.shape
-    rt = rows_per_step
-    rp = _round_up(r_total, rt)
-    # pad with the LAST valid index, not 0: every tail slot still issues a
-    # row DMA, and repeating the final row keeps those fetches on a line
-    # already in flight instead of dragging row 0 back from HBM
-    idx_p = jnp.pad(idx, (0, rp - r_total), mode="edge").astype(jnp.int32)
-    interp = _use_interpret() if interpret is None else interpret
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(rp // rt,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),  # full train set in HBM
-            pl.BlockSpec((1, fp), lambda i, idx_ref: (0, 0)),
-            pl.BlockSpec((1, fp), lambda i, idx_ref: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((rt, fp), lambda i, idx_ref: (i, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((rt, fp), jnp.uint8),
-            pltpu.SemaphoreType.DMA((rt,)),
-        ],
-    )
-    out = pl.pallas_call(
-        functools.partial(_gather_norm_kernel, rt),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((rp, fp), jnp.float32),
-        interpret=interp,
-    )(idx_p, x_u8, scale.reshape(1, fp), bias.reshape(1, fp))
-    return out[:r_total]
-
-
 @functools.partial(jax.jit, static_argnames=("p_max", "interpret"))
 def aircomp_weiszfeld_step(
     w: jnp.ndarray,

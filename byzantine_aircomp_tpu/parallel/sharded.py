@@ -53,8 +53,6 @@ class ShardedFedTrainer(FedTrainer):
         # into per-shard psums.  (Set before the round fn's first trace.)
         if self._agg_impl == "pallas" and self.mesh.size > 1:
             self._agg_impl = "xla"
-        if self._gather_impl == "pallas" and self.mesh.size > 1:
-            self._gather_impl = "xla"  # same GSPMD/pallas_call limitation
         # Krum on a client-sharded stack: route through the explicit
         # ppermute ring (collective.ring_krum*) instead of letting GSPMD
         # partition the K x K Gram matmul, which can lower to an all-gather

@@ -145,37 +145,3 @@ def test_gm_pallas_excludes_nonfinite_rows_like_xla():
     )
     assert np.isfinite(out_x).all() and np.isfinite(out_p).all()
     np.testing.assert_allclose(out_p, out_x, rtol=1e-3, atol=1e-5)
-
-
-def test_gather_normalize_matches_xla_path():
-    import numpy as np
-
-    from byzantine_aircomp_tpu.ops import pallas_kernels as pk
-
-    rng = np.random.default_rng(61)
-    n, f = 300, 256
-    x = rng.integers(0, 256, size=(n, f), dtype=np.uint8)
-    scale = rng.normal(size=f).astype(np.float32)
-    bias = rng.normal(size=f).astype(np.float32)
-    # R not a multiple of rows_per_step exercises the idx padding + slice
-    idx = rng.integers(0, n, size=37).astype(np.int32)
-    got = np.asarray(
-        pk.gather_normalize(
-            jnp.asarray(x), jnp.asarray(idx), jnp.asarray(scale),
-            jnp.asarray(bias),
-        )
-    )
-    want = x[idx].astype(np.float32) * scale + bias
-    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-5)
-
-
-def test_gather_normalize_rejects_unaligned_features():
-    import numpy as np
-
-    from byzantine_aircomp_tpu.ops import pallas_kernels as pk
-
-    x = jnp.zeros((10, 100), jnp.uint8)  # 100 not a LANE multiple
-    with pytest.raises(ValueError):
-        pk.gather_normalize(
-            x, jnp.zeros(4, jnp.int32), jnp.zeros(100), jnp.zeros(100)
-        )

@@ -219,28 +219,6 @@ def test_krum_m_reaches_aggregator():
     assert not np.allclose(a["valLossPath"][-1], b["valLossPath"][-1])
 
 
-def test_pallas_gather_impl_matches_xla_trainer():
-    # gather_impl="pallas" (fused u8 gather+normalize kernel, interpret
-    # mode on CPU) must reproduce the default trainer's round trajectory
-    from byzantine_aircomp_tpu.data import datasets as data_lib
-
-    ds = data_lib.load("mnist", synthetic_train=800, synthetic_val=160)
-    kw = dict(
-        honest_size=7, byz_size=2, attack="classflip", agg="gm2",
-        rounds=1, display_interval=3, batch_size=8, eval_train=False,
-        agg_maxiter=20,
-    )
-    a = FedTrainer(FedConfig(**kw), dataset=ds)
-    b = FedTrainer(FedConfig(gather_impl="pallas", **kw), dataset=ds)
-    assert b._gather_impl == "pallas"  # u8 storage available -> kept
-    a.run_round(0)
-    b.run_round(0)
-    np.testing.assert_allclose(
-        np.asarray(a.flat_params), np.asarray(b.flat_params),
-        rtol=1e-5, atol=1e-7,
-    )
-
-
 @pytest.mark.slow
 def test_resnet18_cifar_training_step_runs():
     # the CIFAR-10 ResNet-18 scale-up rung, scaled to CI size: the flat
