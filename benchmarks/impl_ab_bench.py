@@ -38,6 +38,8 @@ VARIANTS: dict[str, dict] = {
     "baseline": {},
     # hardware RNG for the [K, batch] index draw + channel noise
     "prng_rbg": {"prng_impl": "rbg"},
+    # bf16 aggregator stack: halves the Weiszfeld re-read HBM traffic
+    "stack_bf16": {"stack_dtype": "bf16"},
     # the XLA Weiszfeld path, for reference (the ladder's 62 r/s rung)
     "agg_xla": {"agg_impl": "xla"},
 }

@@ -52,6 +52,7 @@ ARG_TO_FIELD = {
     "sharding": ("sharded", _SHARDING.get),
     "agg_impl": ("agg_impl", None),
     "prng_impl": ("prng_impl", None),
+    "stack_dtype": ("stack_dtype", None),
     "attack_param": ("attack_param", None),
     "krum_m": ("krum_m", None),
     "clip_tau": ("clip_tau", None),
@@ -124,6 +125,13 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["threefry", "rbg", "unsafe_rbg"],
         default="threefry",
         help="per-round PRNG stream (rbg = fast TPU hardware RNG path)",
+    )
+    p.add_argument(
+        "--stack-dtype",
+        choices=["f32", "bf16"],
+        default="f32",
+        help="[K, d] client-stack dtype fed to the aggregator (bf16 halves "
+             "the Weiszfeld re-read traffic; f32 arithmetic; experimental)",
     )
     p.add_argument("--dataset", type=str, default="mnist")
     p.add_argument("--model", type=str, default="MLP")

@@ -73,6 +73,17 @@ class FedConfig:
     # the sharded trainer forces xla on multi-device meshes (GSPMD
     # cannot partition pallas_call)
     agg_impl: str = "auto"
+    # "f32" | "bf16": storage dtype of the [K, d] client stack handed to
+    # the aggregator.  "bf16" halves the aggregator's HBM read traffic —
+    # the Weiszfeld solvers re-read the whole stack every iteration, the
+    # dominant repeated traffic at the bench config — while all arithmetic
+    # stays f32 (type promotion in the XLA paths, explicit in-tile upcast
+    # in the pallas kernels) and the aggregate is returned as f32.
+    # EXPERIMENT: bf16's 8-bit mantissa is coarse relative to the
+    # inter-client weight spread at convergence, so accuracy must be
+    # gate-checked per workload (tests cover the synthetic schedule);
+    # default stays f32
+    stack_dtype: str = "f32"
 
     # determinism
     seed: int = 2021
@@ -126,6 +137,9 @@ class FedConfig:
         assert self.honest_size > 0, "honest_size must be positive"
         assert self.agg_impl in ("auto", "xla", "pallas"), (
             f"agg_impl must be 'auto', 'xla' or 'pallas', got {self.agg_impl!r}"
+        )
+        assert self.stack_dtype in ("f32", "bf16"), (
+            f"stack_dtype must be 'f32' or 'bf16', got {self.stack_dtype!r}"
         )
         assert self.krum_m is None or 1 <= self.krum_m <= self.node_size, (
             f"krum_m must be in [1, K={self.node_size}], got {self.krum_m}"

@@ -77,6 +77,16 @@ def run_title(cfg: FedConfig) -> str:
         title += f"_ci{cfg.clip_iters}"
     if cfg.sign_eta is not None:
         title += f"_eta{cfg.sign_eta}"
+    # implementation knobs that change the TRAJECTORY (not just speed):
+    # a non-threefry PRNG stream and a bf16 aggregator stack both produce
+    # different results from the default run, so they must not alias with
+    # it on checkpoints/pickles (same hazard class as the cclip tau note)
+    if _non_default(cfg, "prng_impl"):
+        title += f"_prng{cfg.prng_impl}"
+    if _non_default(cfg, "stack_dtype"):
+        # prefixed like _prng above: a bare _bf16 would collide with
+        # --mark bf16 on a default-dtype run
+        title += f"_stack{cfg.stack_dtype}"
     if cfg.mark:
         title += f"_{cfg.mark}"
     return title

@@ -152,6 +152,9 @@ class FedTrainer:
         self._agg_impl = cfg.agg_impl
         if self._agg_impl == "auto":
             self._agg_impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        self._stack_dtype = (
+            jnp.bfloat16 if cfg.stack_dtype == "bf16" else jnp.float32
+        )
 
         # server optimizer over the pseudo-gradient (FedAvgM / FedAdam);
         # "none" = take the aggregate directly (reference :354-358)
@@ -285,8 +288,13 @@ class FedTrainer:
                 w_stack = channel_lib.oma(k_chan, w_stack, cfg.noise_var)
 
         with jax.named_scope("aggregate"):
+            # --stack-dtype bf16: hand the aggregator a bf16 view of the
+            # stack (halves its per-Weiszfeld-iteration HBM reads);
+            # arithmetic stays f32 via promotion / in-kernel upcast, and
+            # the aggregate is cast back so the params carry stays f32
+            w_agg = w_stack.astype(self._stack_dtype)
             aggregated = self.agg_fn(
-                w_stack,
+                w_agg,
                 honest_size=cfg.honest_size,
                 key=k_agg,
                 noise_var=cfg.noise_var,
@@ -300,6 +308,7 @@ class FedTrainer:
                 clip_iters=cfg.clip_iters,
                 sign_eta=cfg.sign_eta,
             )
+            aggregated = aggregated.astype(jnp.float32)
             if self._server_tx is not None:
                 # FedOpt: the aggregate defines a pseudo-gradient
                 delta = flat_params - aggregated

@@ -69,8 +69,12 @@ def _finite_centroid(wmatrix, finite):
 
 @AGGREGATORS.register("mean")
 def mean(wmatrix: jnp.ndarray, **_) -> jnp.ndarray:
-    """Column mean (reference ``mean``, ``:186-187``)."""
-    return jnp.mean(wmatrix, axis=0)
+    """Column mean (reference ``mean``, ``:186-187``).
+
+    The f32 upcast keeps the ACCUMULATION f32 whatever the stack dtype
+    (--stack-dtype bf16); XLA fuses the convert into the reduce, so a
+    bf16 stack still pays only bf16 HBM reads."""
+    return jnp.mean(wmatrix.astype(jnp.float32), axis=0)
 
 
 @AGGREGATORS.register("median")
@@ -99,7 +103,9 @@ def trimmed_mean(
     b = int(k * trim_ratio) if beta is None else int(beta)
     srt = jnp.sort(wmatrix, axis=0)
     kept = jax.lax.slice_in_dim(srt, b, k - b, axis=0)
-    return jnp.mean(kept, axis=0)
+    # f32 mean whatever the stack dtype (sort order is dtype-invariant;
+    # only the accumulation needs the upcast)
+    return jnp.mean(kept.astype(jnp.float32), axis=0)
 
 
 def pairwise_sq_dists(wmatrix: jnp.ndarray) -> jnp.ndarray:
@@ -112,7 +118,13 @@ def pairwise_sq_dists(wmatrix: jnp.ndarray) -> jnp.ndarray:
     mapped to +Inf and the diagonal is forced to its exact value 0, so a
     poisoned row scores Inf instead of NaN and can never win the selection.
     """
-    sq = jnp.sum(wmatrix * wmatrix, axis=1)
+    # sq must match the Gram term's f32 accumulation: with a bf16 stack, a
+    # bf16 sq would put ~0.4% relative error on ||w||^2 while gram is f32 —
+    # near convergence (||w_i - w_j||^2 << ||w||^2) the cancellation below
+    # would then be pure quantization noise and Krum selection scrambles
+    sq = jnp.einsum(
+        "kd,kd->k", wmatrix, wmatrix, preferred_element_type=jnp.float32
+    )
     gram = jnp.dot(wmatrix, wmatrix.T, preferred_element_type=jnp.float32)
     dist = sq[:, None] + sq[None, :] - 2.0 * gram
     # a NaN distance can only come from a non-finite row (Inf - Inf in the
@@ -280,7 +292,9 @@ def centered_clip(
     step, whatever its magnitude.  The fixed small iteration count keeps the
     program static (no data-dependent while_loop needed at this cost)."""
     finite = _finite_rows(wmatrix)
-    v = _finite_centroid(wmatrix, finite) if guess is None else guess
+    # f32 regardless of the stack dtype: the carry must stay type-stable
+    v = (_finite_centroid(wmatrix, finite) if guess is None else guess
+         ).astype(jnp.float32)
 
     def step(v, _):
         delta = jnp.where(finite[:, None], wmatrix - v[None, :], 0.0)
@@ -359,7 +373,10 @@ def selected_rows_mean(
     ``idx`` extracts a single row (the single-Krum winner) without the
     dynamic ``wmatrix[argmin]`` gather that makes GSPMD all-gather the
     whole stack."""
-    weights = jnp.zeros(wmatrix.shape[0], wmatrix.dtype).at[idx].set(1.0 / m_sel)
+    # f32 weights whatever the stack dtype: bf16(1/m) * m != 1 would
+    # systematically rescale the aggregate (~0.2% at m=3), a deterministic
+    # drift that compounds round over round
+    weights = jnp.zeros(wmatrix.shape[0], jnp.float32).at[idx].set(1.0 / m_sel)
     masked = jnp.where(weights[:, None] > 0, wmatrix, 0.0)
     return jnp.dot(weights, masked, preferred_element_type=jnp.float32)
 
@@ -439,7 +456,9 @@ def gm2(
     them in-tile (VPU ops on resident data, no extra HBM traffic).
     """
     finite = _finite_rows(wmatrix)
-    init_guess = _finite_centroid(wmatrix, finite) if guess is None else guess
+    # f32 regardless of the stack dtype: the while carry must stay type-stable
+    init_guess = (_finite_centroid(wmatrix, finite) if guess is None
+                  else guess).astype(jnp.float32)
     use_pallas = impl == "pallas" and pallas_kernels.supports_fused(
         wmatrix.shape[1]
     )
@@ -503,7 +522,9 @@ def gm(
     kernel masks them in-tile.
     """
     finite = _finite_rows(wmatrix)
-    init_guess = _finite_centroid(wmatrix, finite) if guess is None else guess
+    # f32 regardless of the stack dtype: the while carry must stay type-stable
+    init_guess = (_finite_centroid(wmatrix, finite) if guess is None
+                  else guess).astype(jnp.float32)
     k_clients, d = wmatrix.shape
     use_pallas = impl == "pallas" and pallas_kernels.supports_fused(d)
 

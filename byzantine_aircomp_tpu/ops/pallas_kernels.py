@@ -90,7 +90,9 @@ def _weiszfeld_kernel(k_actual, tk, w_ref, g_ref, num_ref, den_ref):
         num_ref[:] = jnp.zeros_like(num_ref)
         den_ref[0, 0] = 0.0
 
-    w = w_ref[:]  # [TK, Dp] — the only HBM read of this tile
+    # [TK, Dp] — the only HBM read of this tile; a bf16 stack
+    # (--stack-dtype bf16) is upcast in VMEM so arithmetic stays f32
+    w = w_ref[:].astype(jnp.float32)
     # non-finite rows are EXCLUDED (weight 0) — a point at infinity; the
     # mask costs only VPU ops on the resident tile, matching the XLA
     # path's exclusion (ops.aggregators._finite_rows) with no extra HBM
@@ -154,7 +156,8 @@ def _aircomp_kernel(
 
     scaler = sc_ref[0]
     threshold = GM_THRESHOLD_FACTOR * scaler * scaler
-    w = w_ref[:]  # [TK, Dp] — single HBM read
+    # [TK, Dp] — single HBM read; bf16 stacks are upcast in VMEM
+    w = w_ref[:].astype(jnp.float32)
     # exclude non-finite rows in-tile (they transmit nothing), matching the
     # XLA path's masked inverse distance — see _weiszfeld_kernel
     finite = jnp.all(jnp.isfinite(w), axis=1, keepdims=True)  # [TK, 1]

@@ -428,3 +428,82 @@ def test_gm2_and_cclip_exclude_nonfinite_rows_like_oracle():
     want_c = numpy_ref.centered_clip(w, guess=guess, clip_tau=1.0)
     assert np.isfinite(got_c).all()
     np.testing.assert_allclose(got_c, want_c, rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# bf16 stack input (--stack-dtype bf16): f32 arithmetic, f32-quality output
+
+
+@pytest.mark.parametrize("name", ["gm2", "mean", "cclip", "krum"])
+def test_aggregators_accept_bf16_stack(wmat, name):
+    # the trainer may hand the aggregator a bf16 view of the [K, d] stack;
+    # every aggregator must produce a finite result close to its f32 answer
+    # (bf16 has an 8-bit mantissa: tolerance ~1e-2 relative)
+    fn = agg.resolve(name)
+    kw = dict(honest_size=K - 2, guess=jnp.zeros(D, jnp.float32),
+              key=jax.random.key(0), noise_var=None, maxiter=50, tol=1e-6)
+    f32 = np.asarray(fn(jnp.asarray(wmat), **kw))
+    b16 = np.asarray(fn(jnp.asarray(wmat, jnp.bfloat16), **kw), np.float32)
+    assert np.isfinite(b16).all()
+    np.testing.assert_allclose(b16, f32, rtol=2e-2, atol=2e-2)
+
+
+def test_gm2_bf16_while_carry_is_type_stable(wmat):
+    # guess=None path: the init centroid of a bf16 stack must be upcast or
+    # the while_loop carry would mix bf16/f32 and fail to trace
+    out = agg.gm2(jnp.asarray(wmat, jnp.bfloat16), maxiter=20, tol=1e-6)
+    assert out.dtype == jnp.float32
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_gm_bf16_ideal_channel(wmat):
+    out = agg.gm(
+        jnp.asarray(wmat, jnp.bfloat16), key=jax.random.key(3),
+        noise_var=None, guess=jnp.zeros(D, jnp.float32), maxiter=30, tol=1e-6,
+    )
+    f32 = agg.gm(
+        jnp.asarray(wmat), key=jax.random.key(3),
+        noise_var=None, guess=jnp.zeros(D, jnp.float32), maxiter=30, tol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(f32), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_mean_bf16_stack_accumulates_in_f32():
+    # regression: jnp.mean on a bf16 stack must NOT accumulate in bf16 —
+    # the result must equal f32 math on the (bf16-rounded) inputs exactly
+    rng = np.random.default_rng(11)
+    w = rng.normal(size=(1000, 64)).astype(np.float32)
+    w16 = jnp.asarray(w, jnp.bfloat16)
+    got = np.asarray(agg.mean(w16))
+    want = np.mean(np.asarray(w16, np.float32), axis=0)
+    assert got.dtype == np.float32
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_selected_rows_mean_bf16_weights_exact():
+    # regression: 1/m built in bf16 (bf16(1/3)*3 = 1.00195) would rescale
+    # the aggregate deterministically every round; identical rows must
+    # aggregate to themselves exactly
+    row = np.full(257, 0.731, np.float32)
+    w16 = jnp.asarray(np.tile(row, (9, 1)), jnp.bfloat16)
+    out = np.asarray(agg.selected_rows_mean(w16, jnp.asarray([0, 4, 7]), 3))
+    np.testing.assert_allclose(
+        out, np.asarray(w16[0], np.float32), rtol=1e-6, atol=0
+    )
+
+
+def test_krum_bf16_distances_not_quantization_noise():
+    # regression: ||w||^2 computed in bf16 while the Gram term is f32 makes
+    # near-convergence pairwise distances pure rounding noise.  Build a
+    # tight cluster (spread 1e-3 around norm ~1) plus one row just outside;
+    # Krum must still pick a cluster member, never the planted row
+    rng = np.random.default_rng(13)
+    base = rng.normal(size=300).astype(np.float32) * 0.1
+    w = base + 1e-3 * rng.normal(size=(16, 300)).astype(np.float32)
+    w[-1] = base + 8e-3 * rng.normal(size=300).astype(np.float32)
+    scores = np.asarray(
+        agg.krum_scores(jnp.asarray(w, jnp.bfloat16), honest_size=14)
+    )
+    assert int(np.argmin(scores)) != 15, scores

@@ -163,6 +163,12 @@ def test_run_titles_distinct_across_extension_knobs():
         dict(attack="alie", byz_size=2),
         dict(agg="signmv", sign_eta=0.01),
         dict(agg="signmv"),
+        # trajectory-changing implementation knobs
+        dict(prng_impl="rbg"),
+        dict(prng_impl="unsafe_rbg"),
+        dict(stack_dtype="bf16"),
+        # a mark spelling the dtype must not alias the real knob
+        dict(mark="bf16"),
     ]
     titles = [
         run_title(FedConfig(honest_size=8, **v)) for v in variants

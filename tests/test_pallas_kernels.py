@@ -145,3 +145,25 @@ def test_gm_pallas_excludes_nonfinite_rows_like_xla():
     )
     assert np.isfinite(out_x).all() and np.isfinite(out_p).all()
     np.testing.assert_allclose(out_p, out_x, rtol=1e-3, atol=1e-5)
+
+
+def test_weiszfeld_step_bf16_stack_matches_f32():
+    # --stack-dtype bf16: the kernel upcasts the tile in VMEM; the step on a
+    # bf16 stack must agree with the f32 step on the SAME (bf16-rounded)
+    # values exactly, and with the unrounded f32 stack to bf16 tolerance
+    w = _stack()
+    g = jnp.mean(w, axis=0)
+    w16 = w.astype(jnp.bfloat16)
+    num_p, den_p = pk.weiszfeld_step(w16, g)
+    num_x, den_x = pk.weiszfeld_step(w16.astype(jnp.float32), g)
+    assert num_p.dtype == jnp.float32
+    assert jnp.allclose(num_p, num_x, atol=1e-5)
+    assert jnp.allclose(den_p, den_x, rtol=1e-6)
+
+
+def test_gm2_pallas_bf16_matches_xla_bf16():
+    w = _stack().astype(jnp.bfloat16)
+    g = jnp.mean(w.astype(jnp.float32), axis=0)
+    out_x = agg_lib.gm2(w, guess=g, maxiter=50, tol=1e-7, impl="xla")
+    out_p = agg_lib.gm2(w, guess=g, maxiter=50, tol=1e-7, impl="pallas")
+    assert jnp.allclose(out_x, out_p, atol=1e-5)

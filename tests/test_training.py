@@ -240,3 +240,25 @@ def test_resnet18_cifar_training_step_runs():
     assert np.isfinite(np.asarray(t.flat_params)).all()
     loss, acc = t.evaluate("val")
     assert np.isfinite(loss) and 0.0 <= acc <= 1.0
+
+
+def test_bf16_stack_tracks_f32_trajectory():
+    # --stack-dtype bf16 feeds the aggregator a bf16 stack; the short-run
+    # trajectory must stay close to the f32 run (bf16 mantissa coarseness
+    # shows up as small per-round drift, not divergence) and params stay f32
+    kw = dict(agg="gm2", rounds=2, seed=7)
+    f32 = run_short(make_cfg(**kw))
+    tr = FedTrainer(make_cfg(stack_dtype="bf16", **kw), dataset=small_ds())
+    b16 = tr.train()
+    assert tr.flat_params.dtype == np.float32
+    assert abs(b16["valAccPath"][-1] - f32["valAccPath"][-1]) < 0.05, (
+        b16["valAccPath"], f32["valAccPath"])
+
+
+def test_bf16_stack_survives_weightflip():
+    # the robustness story must not regress under the bf16 experiment
+    paths = run_short(make_cfg(
+        agg="gm2", stack_dtype="bf16", honest_size=9, byz_size=3,
+        attack="weightflip", rounds=3,
+    ))
+    assert paths["valAccPath"][-1] > 0.4, paths["valAccPath"]
