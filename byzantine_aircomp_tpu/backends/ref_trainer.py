@@ -281,7 +281,16 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
     y_va = ds.y_val
 
     k = cfg.node_size
-    shards = data_lib.contiguous_shards(len(x_tr), k)
+    if cfg.partition == "dirichlet":
+        # same derivation (seed, alpha) as the jax trainer, so both
+        # backends train on the identical non-IID split
+        perm, shards = data_lib.dirichlet_shards(
+            y_tr, k, cfg.dirichlet_alpha, seed=cfg.seed
+        )
+        x_tr = x_tr[perm]
+        y_tr = np.asarray(y_tr)[perm]
+    else:
+        shards = data_lib.contiguous_shards(len(x_tr), k)
 
     rng = np.random.default_rng(cfg.seed)
     flat = model.init(rng)

@@ -53,6 +53,8 @@ ARG_TO_FIELD = {
     "agg_impl": ("agg_impl", None),
     "prng_impl": ("prng_impl", None),
     "stack_dtype": ("stack_dtype", None),
+    "partition": ("partition", None),
+    "dirichlet_alpha": ("dirichlet_alpha", None),
     "attack_param": ("attack_param", None),
     "krum_m": ("krum_m", None),
     "clip_tau": ("clip_tau", None),
@@ -125,6 +127,19 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["threefry", "rbg", "unsafe_rbg"],
         default="threefry",
         help="per-round PRNG stream (rbg = fast TPU hardware RNG path)",
+    )
+    p.add_argument(
+        "--partition",
+        choices=["contiguous", "dirichlet"],
+        default="contiguous",
+        help="client data split (dirichlet = label-skewed non-IID)",
+    )
+    p.add_argument(
+        "--dirichlet-alpha",
+        type=float,
+        default=0.3,
+        help="Dirichlet concentration for --partition dirichlet "
+             "(smaller = more label skew)",
     )
     p.add_argument(
         "--stack-dtype",

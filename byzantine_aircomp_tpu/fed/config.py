@@ -100,6 +100,13 @@ class FedConfig:
     model: str = "MLP"
     dataset: str = "mnist"
     fc_width: int = 1024
+    # client data partition: "contiguous" (the reference's equal slices,
+    # approximately IID on an unsorted set, :238-239) or "dirichlet"
+    # (label-skewed non-IID per Hsu et al. 2019 — the standard stress
+    # axis for distance-based Byzantine defenses).  The Dirichlet split
+    # is derived from (seed, alpha); smaller alpha = more skew
+    partition: str = "contiguous"
+    dirichlet_alpha: float = 0.3
 
     # eval
     eval_batch: int = 2000
@@ -137,6 +144,13 @@ class FedConfig:
         assert self.honest_size > 0, "honest_size must be positive"
         assert self.agg_impl in ("auto", "xla", "pallas"), (
             f"agg_impl must be 'auto', 'xla' or 'pallas', got {self.agg_impl!r}"
+        )
+        assert self.partition in ("contiguous", "dirichlet"), (
+            f"partition must be 'contiguous' or 'dirichlet', "
+            f"got {self.partition!r}"
+        )
+        assert self.dirichlet_alpha > 0, (
+            f"dirichlet_alpha must be positive, got {self.dirichlet_alpha}"
         )
         assert self.stack_dtype in ("f32", "bf16"), (
             f"stack_dtype must be 'f32' or 'bf16', got {self.stack_dtype!r}"

@@ -81,6 +81,8 @@ def run_title(cfg: FedConfig) -> str:
     # a non-threefry PRNG stream and a bf16 aggregator stack both produce
     # different results from the default run, so they must not alias with
     # it on checkpoints/pickles (same hazard class as the cclip tau note)
+    if cfg.partition == "dirichlet":
+        title += f"_dir{cfg.dirichlet_alpha}"
     if _non_default(cfg, "prng_impl"):
         title += f"_prng{cfg.prng_impl}"
     if _non_default(cfg, "stack_dtype"):

@@ -112,7 +112,26 @@ class FedTrainer:
         # a [.., 28, 28] array wastes TPU lane tiling (28 of 128 lanes).
         self._sample_shape = self.dataset.input_shape
         self._spatial_input = getattr(type(self.model), "SPATIAL_INPUT", True)
+        # client partition: the reference's contiguous equal slices
+        # (approximately IID on an unsorted set, :238-239) or the
+        # label-Dirichlet non-IID split.  Dirichlet shards are made
+        # contiguous by permuting the train arrays ONCE host-side, so the
+        # on-device uniform-within-[offset, offset+size) sampler and the
+        # 2D u8 gather below are identical for both partitions
+        y_host = np.asarray(self.dataset.y_train)
+        if cfg.partition == "dirichlet":
+            perm, sharding = data_lib.dirichlet_shards(
+                y_host, cfg.node_size, cfg.dirichlet_alpha, seed=cfg.seed
+            )
+            y_host = y_host[perm]
+        else:
+            perm = None
+            sharding = data_lib.contiguous_shards(
+                len(y_host), cfg.node_size
+            )
         raw = self.dataset.x_train_raw
+        if raw is not None and perm is not None:
+            raw = raw[perm]
         if raw is not None:
             # keep the train set uint8 in HBM (4x less random-gather traffic
             # than f32) and normalize after the gather; per-feature flat
@@ -127,14 +146,14 @@ class FedTrainer:
             self._norm_scale = jnp.asarray(1.0 / (255.0 * s))
             self._norm_bias = jnp.asarray(-m / s)
         else:
-            self.x_train = jnp.asarray(self.dataset.x_train).reshape(
-                len(self.dataset.x_train), -1
-            )
+            x_host = np.asarray(self.dataset.x_train)
+            if perm is not None:
+                x_host = x_host[perm]
+            self.x_train = jnp.asarray(x_host).reshape(len(x_host), -1)
             self._norm_scale = None
             self._norm_bias = None
-        self.y_train = jnp.asarray(self.dataset.y_train)
+        self.y_train = jnp.asarray(y_host)
         self._num_features = self.x_train.shape[1]
-        sharding = data_lib.contiguous_shards(len(self.dataset.x_train), cfg.node_size)
         self.offsets = jnp.asarray(sharding.offsets)
         self.sizes = jnp.asarray(sharding.sizes)
 

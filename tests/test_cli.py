@@ -169,6 +169,8 @@ def test_run_titles_distinct_across_extension_knobs():
         dict(stack_dtype="bf16"),
         # a mark spelling the dtype must not alias the real knob
         dict(mark="bf16"),
+        dict(partition="dirichlet"),
+        dict(partition="dirichlet", dirichlet_alpha=0.1),
     ]
     titles = [
         run_title(FedConfig(honest_size=8, **v)) for v in variants

@@ -95,3 +95,98 @@ def test_synthetic_is_learnable():
     pred = ds.x_val.reshape(len(ds.x_val), -1) @ w
     acc = (pred.argmax(1) == ds.y_val).mean()
     assert acc > 0.5
+
+
+# ---------------------------------------------------------------------------
+# Dirichlet non-IID partition
+
+
+def test_dirichlet_shards_is_exact_partition():
+    import numpy as np
+
+    from byzantine_aircomp_tpu.data.datasets import dirichlet_shards
+
+    labels = np.random.default_rng(0).integers(0, 10, size=5000)
+    perm, sh = dirichlet_shards(labels, k=16, alpha=0.3, seed=1)
+    # perm is a permutation of arange(N); shards tile [0, N) exactly
+    assert sorted(perm.tolist()) == list(range(5000))
+    assert sh.sizes.sum() == 5000
+    assert (sh.sizes >= 1).all()
+    np.testing.assert_array_equal(
+        sh.offsets, np.concatenate([[0], np.cumsum(sh.sizes[:-1])])
+    )
+
+
+def test_dirichlet_shards_deterministic_and_skewed():
+    import numpy as np
+
+    from byzantine_aircomp_tpu.data.datasets import dirichlet_shards
+
+    labels = np.random.default_rng(2).integers(0, 10, size=8000)
+    p1, s1 = dirichlet_shards(labels, k=10, alpha=0.1, seed=7)
+    p2, s2 = dirichlet_shards(labels, k=10, alpha=0.1, seed=7)
+    np.testing.assert_array_equal(p1, p2)
+    np.testing.assert_array_equal(s1.sizes, s2.sizes)
+
+    def mean_top_label_frac(perm, sh):
+        fracs = []
+        for o, s in zip(sh.offsets, sh.sizes):
+            shard_labels = labels[perm[o : o + s]]
+            counts = np.bincount(shard_labels, minlength=10)
+            fracs.append(counts.max() / max(1, s))
+        return np.mean(fracs)
+
+    # alpha=0.1 concentrates each client on few labels; alpha=100 ~ IID
+    skew_small = mean_top_label_frac(p1, s1)
+    p3, s3 = dirichlet_shards(labels, k=10, alpha=100.0, seed=7)
+    skew_large = mean_top_label_frac(p3, s3)
+    assert skew_small > 0.5, skew_small
+    assert skew_large < 0.2, skew_large
+
+
+def test_dirichlet_shards_min_one_sample():
+    import numpy as np
+
+    from byzantine_aircomp_tpu.data.datasets import dirichlet_shards
+
+    # tiny set, many clients, extreme skew: empty draws must be repaired
+    labels = np.random.default_rng(3).integers(0, 3, size=40)
+    _, sh = dirichlet_shards(labels, k=32, alpha=0.01, seed=5)
+    assert (sh.sizes >= 1).all()
+    assert sh.sizes.sum() == 40
+
+
+def test_dirichlet_shards_rejects_fewer_samples_than_clients():
+    import numpy as np
+    import pytest
+
+    from byzantine_aircomp_tpu.data.datasets import dirichlet_shards
+
+    with pytest.raises(ValueError, match="1 sample per client"):
+        dirichlet_shards(np.zeros(8, np.int64), k=16, alpha=0.3, seed=0)
+
+
+def test_ref_backend_uses_same_dirichlet_split_as_jax():
+    # --backend ref --partition dirichlet must train on the IDENTICAL
+    # non-IID split as the jax trainer (same (seed, alpha) derivation),
+    # or oracle comparisons on non-IID configs are meaningless
+    import numpy as np
+
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+
+    ds = data_lib.load("mnist", synthetic_train=800, synthetic_val=160)
+    cfg = FedConfig(
+        honest_size=8, rounds=1, display_interval=2, batch_size=8,
+        eval_train=False, partition="dirichlet", dirichlet_alpha=0.3,
+    )
+    tr = FedTrainer(cfg, dataset=ds)
+    perm, shards = data_lib.dirichlet_shards(
+        ds.y_train, cfg.node_size, cfg.dirichlet_alpha, seed=cfg.seed
+    )
+    np.testing.assert_array_equal(np.asarray(tr.offsets), shards.offsets)
+    np.testing.assert_array_equal(np.asarray(tr.sizes), shards.sizes)
+    np.testing.assert_array_equal(
+        np.asarray(tr.y_train), np.asarray(ds.y_train)[perm]
+    )

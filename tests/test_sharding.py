@@ -261,3 +261,24 @@ def test_sharded_bf16_stack_matches_single_device():
         np.asarray(single.flat_params), np.asarray(sharded.flat_params),
         rtol=5e-3, atol=5e-5,
     )
+
+
+def test_sharded_dirichlet_partition_matches_single_device():
+    # unequal per-client shard sizes (the dirichlet split) through the
+    # sharded trainer: the [K] offsets/sizes arrays shard over 'clients'
+    ds = data_lib.load("mnist", synthetic_train=1600, synthetic_val=320)
+    kw = dict(
+        honest_size=13, byz_size=3, attack="classflip", rounds=2,
+        display_interval=3, batch_size=16, agg="gm2", eval_train=False,
+        agg_maxiter=50, partition="dirichlet", dirichlet_alpha=0.3,
+    )
+    single = FedTrainer(FedConfig(**kw), dataset=ds)
+    sharded = ShardedFedTrainer(
+        FedConfig(**kw), dataset=ds, mesh=mesh_lib.make_mesh()
+    )
+    single.run_round(0)
+    sharded.run_round(0)
+    np.testing.assert_allclose(
+        np.asarray(single.flat_params), np.asarray(sharded.flat_params),
+        rtol=5e-4, atol=5e-6,
+    )

@@ -262,3 +262,32 @@ def test_bf16_stack_survives_weightflip():
         attack="weightflip", rounds=3,
     ))
     assert paths["valAccPath"][-1] > 0.4, paths["valAccPath"]
+
+
+def test_dirichlet_partition_learns():
+    # non-IID split: training still converges (slower than IID is fine)
+    paths = run_short(make_cfg(partition="dirichlet", dirichlet_alpha=0.3))
+    assert paths["valAccPath"][-1] > 0.4, paths["valAccPath"]
+
+
+def test_dirichlet_partition_gm2_survives_classflip():
+    # the robustness story under label-skewed clients — the standard
+    # stress case for distance-based defenses
+    paths = run_short(make_cfg(
+        agg="gm2", partition="dirichlet", dirichlet_alpha=0.5,
+        honest_size=9, byz_size=3, attack="classflip", rounds=3,
+    ))
+    assert paths["valAccPath"][-1] > 0.35, paths["valAccPath"]
+
+
+def test_dirichlet_partition_changes_client_data():
+    # the permuted shards must actually differ from the contiguous split
+    ds = small_ds()
+    a = FedTrainer(make_cfg(), dataset=ds)
+    b = FedTrainer(make_cfg(partition="dirichlet"), dataset=ds)
+    assert not np.array_equal(np.asarray(a.sizes), np.asarray(b.sizes)) or \
+        not np.array_equal(np.asarray(a.y_train), np.asarray(b.y_train))
+    # but the multiset of labels is preserved by the permutation
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(a.y_train)), np.sort(np.asarray(b.y_train))
+    )
