@@ -187,6 +187,8 @@ def main(argv=None) -> None:
         noise_var=args.var,
         seed=args.seed,
         eval_train=False,
+        partition=args.partition,
+        dirichlet_alpha=args.dirichlet_alpha,
         attack_param=args.attack_param,
         krum_m=args.krum_m,
         clip_tau=args.clip_tau,

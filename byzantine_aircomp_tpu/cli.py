@@ -25,8 +25,16 @@ _SHARDING = {"auto": None, "on": True, "off": False}
 # Both the kwargs construction and the preset explicit-override scan derive
 # from this, so the two cannot drift.
 def add_knob_flags(p) -> None:
-    """The attack/defense magnitude knobs, shared between the main CLI and
-    the sweep tool so the two surfaces (and their help text) cannot drift."""
+    """The attack/defense magnitude + data-partition knobs, shared between
+    the main CLI and the sweep tool so the two surfaces (and their help
+    text) cannot drift."""
+    p.add_argument("--partition", choices=["contiguous", "dirichlet"],
+                   default="contiguous",
+                   help="client data split (dirichlet = label-skewed "
+                        "non-IID, Hsu et al. 2019)")
+    p.add_argument("--dirichlet-alpha", type=float, default=0.3,
+                   help="Dirichlet concentration for --partition dirichlet "
+                        "(smaller = more label skew)")
     p.add_argument("--attack-param", type=float, default=None,
                    help="scalar attack magnitude (alie z / ipm eps / gaussian "
                         "sigma / minmax+minsum fixed gamma)")
@@ -127,19 +135,6 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["threefry", "rbg", "unsafe_rbg"],
         default="threefry",
         help="per-round PRNG stream (rbg = fast TPU hardware RNG path)",
-    )
-    p.add_argument(
-        "--partition",
-        choices=["contiguous", "dirichlet"],
-        default="contiguous",
-        help="client data split (dirichlet = label-skewed non-IID)",
-    )
-    p.add_argument(
-        "--dirichlet-alpha",
-        type=float,
-        default=0.3,
-        help="Dirichlet concentration for --partition dirichlet "
-             "(smaller = more label skew)",
     )
     p.add_argument(
         "--stack-dtype",

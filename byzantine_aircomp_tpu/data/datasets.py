@@ -423,10 +423,10 @@ def dirichlet_shards(
         counts[np.argsort(-frac)[:short]] += 1
         for i, part in enumerate(np.split(idx, np.cumsum(counts)[:-1])):
             per_client[i].append(part)
-    shards = [
-        np.concatenate(parts) if parts else np.empty(0, np.int64)
-        for parts in per_client
-    ]
+    # every parts list has one (possibly empty) array per label class, so
+    # concatenate is always well-defined; an empty SHARD is a zero-length
+    # result, repaired below
+    shards = [np.concatenate(parts) for parts in per_client]
     for i, s in enumerate(shards):
         if len(s) == 0:
             donor = int(np.argmax([len(t) for t in shards]))

@@ -172,6 +172,7 @@ def test_ref_backend_uses_same_dirichlet_split_as_jax():
     # or oracle comparisons on non-IID configs are meaningless
     import numpy as np
 
+    from byzantine_aircomp_tpu.backends.ref_trainer import run_ref
     from byzantine_aircomp_tpu.data import datasets as data_lib
     from byzantine_aircomp_tpu.fed.config import FedConfig
     from byzantine_aircomp_tpu.fed.train import FedTrainer
@@ -190,3 +191,19 @@ def test_ref_backend_uses_same_dirichlet_split_as_jax():
     np.testing.assert_array_equal(
         np.asarray(tr.y_train), np.asarray(ds.y_train)[perm]
     )
+
+    # and run_ref must actually CONSUME the partition: a dirichlet run
+    # diverges from the contiguous one (a silently-ignored flag would
+    # produce identical trajectories), while staying a working training run
+    ref_kw = dict(
+        honest_size=8, rounds=2, display_interval=5, batch_size=8,
+        eval_train=False,
+    )
+    quiet = lambda s: None
+    r_iid = run_ref(FedConfig(**ref_kw), log_fn=quiet, dataset=ds)
+    r_skew = run_ref(
+        FedConfig(partition="dirichlet", dirichlet_alpha=0.1, **ref_kw),
+        log_fn=quiet, dataset=ds,
+    )
+    assert r_iid["valAccPath"] != r_skew["valAccPath"]
+    assert r_skew["valAccPath"][-1] > 0.15

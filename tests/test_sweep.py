@@ -100,3 +100,22 @@ def test_sweep_rejects_bad_seeds():
     with pytest.raises(ValueError):
         sweep.run_sweep(["mean"], [None], _cfg_kw(), dataset=object(),
                         log=lambda s: None, seeds=0)
+
+
+def test_sweep_partition_flag_reaches_cells():
+    # --partition dirichlet must change the cell's training data split
+    from byzantine_aircomp_tpu.analysis.sweep import run_sweep
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+
+    ds = data_lib.load("mnist", synthetic_train=800, synthetic_val=160)
+    kw = dict(
+        honest_size=8, byz_size=0, rounds=1, display_interval=2,
+        batch_size=8, eval_train=False,
+    )
+    iid = run_sweep(["mean"], [None], dict(kw), dataset=ds, log=lambda s: None)
+    skew = run_sweep(
+        ["mean"], [None],
+        dict(kw, partition="dirichlet", dirichlet_alpha=0.1),
+        dataset=ds, log=lambda s: None,
+    )
+    assert iid[("mean", None)]["val_acc"] != skew[("mean", None)]["val_acc"]

@@ -291,3 +291,35 @@ def test_dirichlet_partition_changes_client_data():
     np.testing.assert_array_equal(
         np.sort(np.asarray(a.y_train)), np.sort(np.asarray(b.y_train))
     )
+
+
+@pytest.mark.slow
+def test_noniid_matrix_headline_claims():
+    """Executable lock on the docs/RESULTS.md non-IID matrix's ordering
+    claims at its own config (mnist_hard, dirichlet alpha=0.3, K=20, B=4):
+
+    - coordinatewise median degrades badly under label skew with NO
+      attacker, while gm2 stays near the honest baseline;
+    - gm2 survives weightflip under skew, mean collapses.
+    """
+    ds = data_lib.load("mnist_hard", synthetic_train=12000, synthetic_val=3000)
+    base = dict(
+        honest_size=20, byz_size=0, rounds=5, display_interval=10,
+        batch_size=32, eval_train=False, agg_maxiter=100,
+        partition="dirichlet", dirichlet_alpha=0.3,
+    )
+
+    def final(**kw):
+        cfg = FedConfig(**{**base, **kw})
+        return FedTrainer(cfg, dataset=ds).train()["valAccPath"][-1]
+
+    gm2_clean = final(agg="gm2")
+    median_clean = final(agg="median")
+    assert gm2_clean > 0.7, gm2_clean
+    assert median_clean < gm2_clean - 0.15, (median_clean, gm2_clean)
+
+    atk = dict(honest_size=16, byz_size=4, attack="weightflip")
+    gm2_wf = final(agg="gm2", **atk)
+    mean_wf = final(agg="mean", **atk)
+    assert gm2_wf > 0.7, gm2_wf
+    assert mean_wf < 0.3, mean_wf
