@@ -189,6 +189,7 @@ def main(argv=None) -> None:
         eval_train=False,
         partition=args.partition,
         dirichlet_alpha=args.dirichlet_alpha,
+        participation=args.participation,
         attack_param=args.attack_param,
         krum_m=args.krum_m,
         clip_tau=args.clip_tau,

@@ -315,11 +315,23 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
     server_t = 0
 
     byz0 = cfg.honest_size  # Byzantine clients are the last byz_size rows
+    # partial participation: stratified per-iteration draw, mirroring
+    # fed/train.py (round(f*H) honest + round(f*B) Byzantine rows; the
+    # RNG streams differ across backends as everywhere else — parity on
+    # participation configs is distributional, not bitwise)
+    part_h, part_b = cfg.participant_counts()
     for r in range(cfg.rounds):
         t0 = time.perf_counter()
         for _ in range(cfg.display_interval):
-            w_stack = np.empty((k, flat.size), np.float32)
-            for node in range(k):
+            if cfg.participation < 1.0:
+                participants = np.concatenate([
+                    rng.permutation(cfg.honest_size)[:part_h],
+                    byz0 + rng.permutation(cfg.byz_size)[:part_b],
+                ]).astype(np.int64)
+            else:
+                participants = np.arange(k)
+            w_stack = np.empty((len(participants), flat.size), np.float32)
+            for row, node in enumerate(participants):
                 lo = shards.offsets[node]
                 # local_steps > 1 = FedAvg regime (fed/train.py
                 # _per_client_weights): E local SGD steps, each on a fresh
@@ -339,30 +351,30 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
                     if cfg.fedprox_mu:
                         g = g + cfg.fedprox_mu * (w_c - flat)
                     w_c = w_c - cfg.gamma * (g + cfg.weight_decay * w_c)
-                w_stack[node] = w_c
+                w_stack[row] = w_c
 
-            if cfg.attack == "weightflip" and cfg.byz_size:
-                w_stack = numpy_ref.weightflip(w_stack, cfg.byz_size)
-            elif cfg.attack == "signflip" and cfg.byz_size:
-                w_stack[-cfg.byz_size :] *= -1.0
-            elif cfg.attack == "alie" and cfg.byz_size:
+            if cfg.attack == "weightflip" and part_b:
+                w_stack = numpy_ref.weightflip(w_stack, part_b)
+            elif cfg.attack == "signflip" and part_b:
+                w_stack[-part_b :] *= -1.0
+            elif cfg.attack == "alie" and part_b:
                 z = 1.5 if cfg.attack_param is None else cfg.attack_param
-                w_stack = numpy_ref.alie(w_stack, cfg.byz_size, z=z)
-            elif cfg.attack == "ipm" and cfg.byz_size:
+                w_stack = numpy_ref.alie(w_stack, part_b, z=z)
+            elif cfg.attack == "ipm" and part_b:
                 eps = 0.5 if cfg.attack_param is None else cfg.attack_param
-                w_stack = numpy_ref.ipm(w_stack, cfg.byz_size, eps=eps)
-            elif cfg.attack == "gaussian" and cfg.byz_size:
+                w_stack = numpy_ref.ipm(w_stack, part_b, eps=eps)
+            elif cfg.attack == "gaussian" and part_b:
                 sigma = 1.0 if cfg.attack_param is None else cfg.attack_param
-                w_stack[-cfg.byz_size :] = sigma * rng.normal(
-                    size=(cfg.byz_size, flat.size)
+                w_stack[-part_b :] = sigma * rng.normal(
+                    size=(part_b, flat.size)
                 ).astype(np.float32)
-            elif cfg.attack == "minmax" and cfg.byz_size:
+            elif cfg.attack == "minmax" and part_b:
                 w_stack = numpy_ref.minmax(
-                    w_stack, cfg.byz_size, gamma=cfg.attack_param
+                    w_stack, part_b, gamma=cfg.attack_param
                 )
-            elif cfg.attack == "minsum" and cfg.byz_size:
+            elif cfg.attack == "minsum" and part_b:
                 w_stack = numpy_ref.minsum(
-                    w_stack, cfg.byz_size, gamma=cfg.attack_param
+                    w_stack, part_b, gamma=cfg.attack_param
                 )
 
             # channel-dispatch rule (mirrors ops.aggregators.needs_oma_prepass):
@@ -391,11 +403,11 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
             elif cfg.agg == "trimmed_mean":
                 agg_out = numpy_ref.trimmed_mean(w_stack)
             elif cfg.agg in ("krum", "Krum"):
-                agg_out = numpy_ref.krum(w_stack, cfg.honest_size).copy()
+                agg_out = numpy_ref.krum(w_stack, part_h).copy()
             elif cfg.agg == "multi_krum":
-                agg_out = numpy_ref.multi_krum(w_stack, cfg.honest_size, m=cfg.krum_m)
+                agg_out = numpy_ref.multi_krum(w_stack, part_h, m=cfg.krum_m)
             elif cfg.agg == "bulyan":
-                agg_out = numpy_ref.bulyan(w_stack, cfg.honest_size)
+                agg_out = numpy_ref.bulyan(w_stack, part_h)
             elif cfg.agg == "cclip":
                 agg_out = numpy_ref.centered_clip(
                     w_stack, guess=flat,
@@ -430,7 +442,7 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
             else:  # "none": take the aggregate (reference :354-358)
                 flat = agg_out
 
-        w_h = w_stack[: cfg.honest_size]
+        w_h = w_stack[:part_h]
         variance = float(((w_h - w_h.mean(axis=0)) ** 2).sum(axis=1).mean())
         dt = time.perf_counter() - t0
 

@@ -35,6 +35,10 @@ def add_knob_flags(p) -> None:
     p.add_argument("--dirichlet-alpha", type=float, default=0.3,
                    help="Dirichlet concentration for --partition dirichlet "
                         "(smaller = more label skew)")
+    p.add_argument("--participation", type=float, default=1.0,
+                   help="fraction of clients active per iteration "
+                        "(stratified honest/Byzantine draw; 1.0 = all, "
+                        "the reference's behavior)")
     p.add_argument("--attack-param", type=float, default=None,
                    help="scalar attack magnitude (alie z / ipm eps / gaussian "
                         "sigma / minmax+minsum fixed gamma)")
@@ -63,6 +67,7 @@ ARG_TO_FIELD = {
     "stack_dtype": ("stack_dtype", None),
     "partition": ("partition", None),
     "dirichlet_alpha": ("dirichlet_alpha", None),
+    "participation": ("participation", None),
     "attack_param": ("attack_param", None),
     "krum_m": ("krum_m", None),
     "clip_tau": ("clip_tau", None),

@@ -107,6 +107,25 @@ class FedConfig:
     # is derived from (seed, alpha); smaller alpha = more skew
     partition: str = "contiguous"
     dirichlet_alpha: float = 0.3
+    # partial participation (the FedAvg setting; the reference activates
+    # every client every iteration): each global iteration runs a
+    # STRATIFIED sample of round(participation * honest_size) honest and
+    # round(participation * byz_size) Byzantine clients, drawn fresh per
+    # iteration.  Stratification keeps the Byzantine fraction (and so the
+    # aggregators' honest_size contract) exact with static shapes; 1.0
+    # (default) is bit-identical to the full-participation program
+    participation: float = 1.0
+
+    def participant_counts(self) -> tuple:
+        """(honest, Byzantine) rows per iteration — the single source of
+        the round(f*H)/round(f*B) stratified-draw policy (trainer, sharded
+        divisibility check, oracle backend, and validation all use it)."""
+        if self.participation < 1.0:
+            return (
+                round(self.participation * self.honest_size),
+                round(self.participation * self.byz_size),
+            )
+        return self.honest_size, self.byz_size
 
     # eval
     eval_batch: int = 2000
@@ -145,6 +164,20 @@ class FedConfig:
         assert self.agg_impl in ("auto", "xla", "pallas"), (
             f"agg_impl must be 'auto', 'xla' or 'pallas', got {self.agg_impl!r}"
         )
+        assert 0.0 < self.participation <= 1.0, (
+            f"participation must be in (0, 1], got {self.participation}"
+        )
+        part_h, part_b = self.participant_counts()
+        if self.participation < 1.0:
+            assert part_h >= 1, (
+                f"participation {self.participation} rounds to zero honest "
+                f"participants of {self.honest_size}"
+            )
+            assert self.byz_size == 0 or part_b >= 1, (
+                f"participation {self.participation} would silently drop "
+                f"all {self.byz_size} Byzantine clients (rounds to 0); "
+                f"raise the fraction or set byz_size=0 explicitly"
+            )
         assert self.partition in ("contiguous", "dirichlet"), (
             f"partition must be 'contiguous' or 'dirichlet', "
             f"got {self.partition!r}"
@@ -155,8 +188,12 @@ class FedConfig:
         assert self.stack_dtype in ("f32", "bf16"), (
             f"stack_dtype must be 'f32' or 'bf16', got {self.stack_dtype!r}"
         )
-        assert self.krum_m is None or 1 <= self.krum_m <= self.node_size, (
-            f"krum_m must be in [1, K={self.node_size}], got {self.krum_m}"
+        # aggregators see round(f*H) + round(f*B) rows under partial
+        # participation, so selection counts are bounded by that, not K
+        eff_k = part_h + part_b
+        assert self.krum_m is None or 1 <= self.krum_m <= eff_k, (
+            f"krum_m must be in [1, {eff_k}] (participating clients), "
+            f"got {self.krum_m}"
         )
         assert (self.clip_tau is None or self.clip_tau > 0) and self.clip_iters >= 1, (
             f"clip_tau must be > 0 (or None = adaptive) and clip_iters >= 1, "

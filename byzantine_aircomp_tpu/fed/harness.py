@@ -83,6 +83,8 @@ def run_title(cfg: FedConfig) -> str:
     # it on checkpoints/pickles (same hazard class as the cclip tau note)
     if cfg.partition == "dirichlet":
         title += f"_dir{cfg.dirichlet_alpha}"
+    if cfg.participation < 1.0:
+        title += f"_part{cfg.participation}"
     if _non_default(cfg, "prng_impl"):
         title += f"_prng{cfg.prng_impl}"
     if _non_default(cfg, "stack_dtype"):

@@ -163,6 +163,18 @@ class FedTrainer:
             mask[-cfg.byz_size :] = True
         self.byz_mask = jnp.asarray(mask)
 
+        # partial participation: per-iteration stratified sample sizes.
+        # Participants are drawn inside the jitted iteration (fresh keys);
+        # only the COUNTS are static, so the [m, d] stack keeps one shape
+        self._part_h, self._part_b = cfg.participant_counts()
+        if cfg.participation < 1.0:
+            pmask = np.zeros(self._part_h + self._part_b, bool)
+            if self._part_b:
+                pmask[-self._part_b :] = True
+            self._part_mask = jnp.asarray(pmask)
+        else:
+            self._part_mask = self.byz_mask
+
         # effective Weiszfeld impl; the sharded trainer overrides this before
         # the round fn is first traced (GSPMD cannot partition pallas_call).
         # "auto": the fused pallas step wins ~18% end-to-end on a real TPU
@@ -268,7 +280,26 @@ class FedTrainer:
         passes entirely."""
         cfg = self.cfg
         flat_params, opt_state = carry
-        k_batch, k_chan, k_agg, k_msg = jax.random.split(key, 4)
+        m_h, m_b = self._part_h, self._part_b
+        if cfg.participation < 1.0:
+            # stratified participant draw: m_h of the honest, m_b of the
+            # Byzantine, fresh every iteration.  The extra key split only
+            # exists on this program, so participation=1.0 consumes the
+            # exact default RNG stream (checkpoint/replay compatible)
+            k_batch, k_chan, k_agg, k_msg, k_part = jax.random.split(key, 5)
+            kh, kb = jax.random.split(k_part)
+            part = jax.random.permutation(kh, cfg.honest_size)[:m_h]
+            if m_b:
+                part = jnp.concatenate([
+                    part,
+                    cfg.honest_size
+                    + jax.random.permutation(kb, cfg.byz_size)[:m_b],
+                ])
+            offsets = self.offsets[part]
+            sizes = self.sizes[part]
+        else:
+            k_batch, k_chan, k_agg, k_msg = jax.random.split(key, 4)
+            offsets, sizes = self.offsets, self.sizes
 
         with jax.named_scope("client_local_step"):
             # E local steps per client, each on a fresh with-replacement
@@ -277,29 +308,32 @@ class FedTrainer:
             # w <- fp - gamma*(g*scale + wd*fp), and the [K, E*B] index
             # stream equals the single-step stream (same key, same count)
             idx = data_lib.sample_client_batch_indices(
-                k_batch, self.offsets, self.sizes,
+                k_batch, offsets, sizes,
                 cfg.local_steps * cfg.batch_size,
             )
-            x = x_train[idx]  # [K, E*B, features] on-device 2D gather
+            x = x_train[idx]  # [m, E*B, features] on-device 2D gather
             if self._norm_scale is not None:
                 # u8 rows -> normalized floats: same map as the host
                 # path (datasets._normalize) up to float re-association,
                 # as one multiply-add post-gather on device
                 x = x.astype(jnp.float32) * self._norm_scale + self._norm_bias
-            shape = (cfg.node_size, cfg.local_steps, cfg.batch_size)
+            shape = (m_h + m_b, cfg.local_steps, cfg.batch_size)
             x = x.reshape(
                 shape + (self._sample_shape if self._spatial_input else (-1,))
             )
             y = y_train[idx].reshape(shape)
             w_stack = jax.vmap(self._per_client_weights, in_axes=(None, 0, 0, 0))(
-                flat_params, x, y, self.byz_mask
+                flat_params, x, y, self._part_mask
             )
             w_stack = self._constrain_stack(w_stack)
 
         with jax.named_scope("message_attack"):
+            # called even when m_b == 0: apply_message validates
+            # attack_param BEFORE its no-op early-out, so a bogus knob
+            # fails loudly (ops/attacks.py) instead of being ignored
             if self.attack is not None:
                 w_stack = self.attack.apply_message(
-                    w_stack, cfg.byz_size, k_msg, param=cfg.attack_param
+                    w_stack, m_b, k_msg, param=cfg.attack_param
                 )
 
         with jax.named_scope("channel"):
@@ -314,7 +348,7 @@ class FedTrainer:
             w_agg = w_stack.astype(self._stack_dtype)
             aggregated = self.agg_fn(
                 w_agg,
-                honest_size=cfg.honest_size,
+                honest_size=m_h,
                 key=k_agg,
                 noise_var=cfg.noise_var,
                 guess=flat_params,
@@ -340,7 +374,7 @@ class FedTrainer:
             new_flat = self._constrain_params(new_flat)
         variance = jax.lax.cond(
             want_variance,
-            lambda w: honest_variance(w, cfg.honest_size),
+            lambda w: honest_variance(w, m_h),
             lambda w: jnp.float32(0.0),
             w_stack,
         )

@@ -44,6 +44,15 @@ class ShardedFedTrainer(FedTrainer):
                 f"node_size {cfg.node_size} must be divisible by the "
                 f"'{mesh_lib.CLIENT_AXIS}' mesh axis ({n_clients_axis})"
             )
+        if cfg.participation < 1.0:
+            m = sum(cfg.participant_counts())
+            if m % n_clients_axis:
+                raise ValueError(
+                    f"participation {cfg.participation} gives a {m}-row "
+                    f"stack, not divisible by the '{mesh_lib.CLIENT_AXIS}' "
+                    f"mesh axis ({n_clients_axis}); pick a fraction whose "
+                    f"participant count divides the mesh"
+                )
         super().__init__(cfg, dataset=dataset)
 
         # GSPMD has no partitioning rule for pallas_call: with the [K, d]

@@ -171,6 +171,7 @@ def test_run_titles_distinct_across_extension_knobs():
         dict(mark="bf16"),
         dict(partition="dirichlet"),
         dict(partition="dirichlet", dirichlet_alpha=0.1),
+        dict(participation=0.5),
     ]
     titles = [
         run_title(FedConfig(honest_size=8, **v)) for v in variants

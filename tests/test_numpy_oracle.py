@@ -91,3 +91,23 @@ def test_ref_backend_attack_param_forwarded():
             FedConfig(**{**kw, "attack": "weightflip"}, attack_param=1.0),
             log_fn=lambda s: None, dataset=ds,
         )
+
+
+def test_ref_backend_partial_participation_runs_and_learns():
+    # the oracle mirrors the stratified draw (round(f*H) + round(f*B) rows)
+    import numpy as np
+
+    from byzantine_aircomp_tpu.backends.ref_trainer import run_ref
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+
+    ds = data_lib.load("mnist", synthetic_train=1000, synthetic_val=200)
+    rec = run_ref(
+        FedConfig(
+            honest_size=6, byz_size=2, attack="weightflip", agg="gm2",
+            participation=0.5, rounds=2, display_interval=5, batch_size=8,
+            eval_train=False, agg_maxiter=50,
+        ),
+        log_fn=lambda s: None, dataset=ds,
+    )
+    assert rec["valAccPath"][-1] > 0.3, rec["valAccPath"]

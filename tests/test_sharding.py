@@ -282,3 +282,29 @@ def test_sharded_dirichlet_partition_matches_single_device():
         np.asarray(single.flat_params), np.asarray(sharded.flat_params),
         rtol=5e-4, atol=5e-6,
     )
+
+
+def test_sharded_rejects_indivisible_participation():
+    # 16 clients at f=0.75 -> 12 rows, not divisible by the 8-device axis
+    with pytest.raises(ValueError, match="participation"):
+        ShardedFedTrainer(
+            FedConfig(honest_size=16, participation=0.75, rounds=1,
+                      eval_train=False),
+            dataset=data_lib.load("mnist", synthetic_train=400,
+                                  synthetic_val=100),
+            mesh=mesh_lib.make_mesh(),
+        )
+
+
+def test_sharded_partial_participation_runs():
+    # 13 honest + 3 byz at f=0.5 -> 6 + 2 = 8 rows, divisible by the
+    # 8-device clients axis; the sharded program must build and run
+    ds = data_lib.load("mnist", synthetic_train=1600, synthetic_val=320)
+    tr = ShardedFedTrainer(
+        FedConfig(honest_size=13, byz_size=3, attack="classflip", agg="gm2",
+                  participation=0.5, rounds=1, display_interval=3,
+                  batch_size=16, eval_train=False, agg_maxiter=50),
+        dataset=ds, mesh=mesh_lib.make_mesh(),
+    )
+    tr.run_round(0)
+    assert np.isfinite(np.asarray(tr.flat_params)).all()

@@ -119,3 +119,27 @@ def test_sweep_partition_flag_reaches_cells():
         dataset=ds, log=lambda s: None,
     )
     assert iid[("mean", None)]["val_acc"] != skew[("mean", None)]["val_acc"]
+
+
+def test_sweep_participation_flag_reaches_cells():
+    # regression: --participation was accepted by argparse but not
+    # forwarded into cfg_kw, silently benchmarking full participation
+    from byzantine_aircomp_tpu.analysis import sweep as sweep_mod
+
+    captured = {}
+    orig = sweep_mod.run_sweep
+
+    def spy(aggs, attacks, cfg_kw, **kw):
+        captured.update(cfg_kw)
+        return orig(aggs, attacks, cfg_kw, **kw)
+
+    sweep_mod.run_sweep, orig_fn = spy, sweep_mod.run_sweep
+    try:
+        sweep_mod.main([
+            "--aggs", "mean", "--attacks", "none", "--K", "8", "--B", "0",
+            "--rounds", "1", "--interval", "2", "--batch-size", "8",
+            "--participation", "0.5",
+        ])
+    finally:
+        sweep_mod.run_sweep = orig_fn
+    assert captured.get("participation") == 0.5

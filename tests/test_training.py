@@ -323,3 +323,36 @@ def test_noniid_matrix_headline_claims():
     mean_wf = final(agg="mean", **atk)
     assert gm2_wf > 0.7, gm2_wf
     assert mean_wf < 0.3, mean_wf
+
+
+def test_partial_participation_learns():
+    # half the clients active per iteration (stratified): still converges
+    paths = run_short(make_cfg(participation=0.5, rounds=3))
+    assert paths["valAccPath"][-1] > 0.45, paths["valAccPath"]
+
+
+def test_partial_participation_keeps_byz_fraction_and_defends():
+    # 12 clients (9 honest, 3 byz) at f=2/3 -> 6 honest + 2 byz per
+    # iteration; gm2 must still defend weightflip at the same fraction
+    paths = run_short(make_cfg(
+        agg="gm2", honest_size=9, byz_size=3, attack="weightflip",
+        participation=2 / 3, rounds=3,
+    ))
+    assert paths["valAccPath"][-1] > 0.4, paths["valAccPath"]
+
+
+def test_full_participation_trajectory_unchanged():
+    # participation=1.0 must consume the exact default RNG stream — the
+    # explicitly-passed default equals the omitted default bit-for-bit
+    a = run_short(make_cfg(rounds=2, seed=5))
+    b = run_short(make_cfg(rounds=2, seed=5, participation=1.0))
+    np.testing.assert_array_equal(a["valAccPath"], b["valAccPath"])
+
+
+def test_participation_validation():
+    with pytest.raises(AssertionError, match="participation"):
+        make_cfg(participation=0.0).validate()
+    with pytest.raises(AssertionError, match="Byzantine"):
+        # 0.1 * 3 byz rounds to 0 — must refuse, not silently drop the attack
+        make_cfg(honest_size=9, byz_size=3, attack="weightflip",
+                 participation=0.1).validate()
