@@ -173,6 +173,49 @@ def bulyan(w: np.ndarray, honest_size: int) -> np.ndarray:
     return out
 
 
+def dnc(
+    w: np.ndarray,
+    honest_size: int,
+    rng: np.random.Generator,
+    dnc_iters: int = 3,
+    dnc_sub_dim: int = 10000,
+    dnc_c: float = 1.0,
+) -> np.ndarray:
+    """Oracle for the framework's DnC (an extension; Shejwalkar &
+    Houmansadr NDSS 2021): per round, sample coordinates, center, score
+    clients by squared projection onto the top singular vector, flag the
+    ceil(c*B) highest; aggregate = mean of never-flagged clients.  Uses
+    exact SVD where the jax path power-iterates — agreement is
+    distributional (same flagged sets on well-separated stacks)."""
+    k, d = w.shape
+    b = k - honest_size
+    n_remove = int(np.ceil(dnc_c * b))
+    if n_remove * dnc_iters >= k:  # same contract as the jax path
+        raise ValueError(
+            f"dnc removes ceil(c*B)={n_remove} clients per round x "
+            f"{dnc_iters} rounds but K={k}; need K > removals"
+        )
+    finite = np.isfinite(w).all(axis=1)
+    keep = finite.copy()
+    r = min(d, dnc_sub_dim)
+    for _ in range(dnc_iters):
+        cols = rng.integers(0, d, r)  # with replacement, as the jax path
+        sub = np.where(finite[:, None], w[:, cols], 0.0)
+        centered = sub - sub.sum(axis=0) / max(finite.sum(), 1)
+        centered = np.where(finite[:, None], centered, 0.0)
+        _, _, vt = np.linalg.svd(centered, full_matrices=False)
+        scores = (centered @ vt[0]) ** 2
+        scores = np.where(finite, scores, np.inf)
+        if n_remove:
+            keep[np.argsort(scores)[-n_remove:]] = False
+    if keep.any():
+        return w[keep].mean(axis=0).astype(np.float32)
+    return (
+        np.where(finite[:, None], w, 0.0).sum(axis=0)
+        / max(finite.sum(), 1)
+    ).astype(np.float32)
+
+
 def sign_majority_vote(
     w: np.ndarray,
     guess: np.ndarray,

@@ -413,6 +413,11 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
                     w_stack, guess=flat,
                     clip_tau=cfg.clip_tau, clip_iters=cfg.clip_iters,
                 )
+            elif cfg.agg == "dnc":
+                agg_out = numpy_ref.dnc(
+                    w_stack, part_h, rng, dnc_iters=cfg.dnc_iters,
+                    dnc_sub_dim=cfg.dnc_sub_dim, dnc_c=cfg.dnc_c,
+                )
             elif cfg.agg == "signmv":
                 agg_out = numpy_ref.sign_majority_vote(
                     w_stack, guess=flat, noise_var=cfg.noise_var,

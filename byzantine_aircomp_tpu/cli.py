@@ -52,6 +52,13 @@ def add_knob_flags(p) -> None:
     p.add_argument("--sign-eta", type=float, default=None,
                    help="one-bit OTA majority-vote step size (agg=signmv; "
                         "default: coordinatewise median delta magnitude)")
+    p.add_argument("--dnc-iters", type=int, default=3,
+                   help="dnc filtering rounds (agg=dnc)")
+    p.add_argument("--dnc-sub-dim", type=int, default=10000,
+                   help="dnc coordinate-subsample size (agg=dnc)")
+    p.add_argument("--dnc-c", type=float, default=1.0,
+                   help="dnc removal multiplier: ceil(c*B) flagged per "
+                        "round (agg=dnc)")
 
 
 ARG_TO_FIELD = {
@@ -73,6 +80,9 @@ ARG_TO_FIELD = {
     "clip_tau": ("clip_tau", None),
     "clip_iters": ("clip_iters", None),
     "sign_eta": ("sign_eta", None),
+    "dnc_iters": ("dnc_iters", None),
+    "dnc_sub_dim": ("dnc_sub_dim", None),
+    "dnc_c": ("dnc_c", None),
     "profile_dir": ("profile_dir", None),
     "model_parallel": ("model_parallel", None),
     "rounds": ("rounds", None),

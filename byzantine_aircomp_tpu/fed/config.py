@@ -66,6 +66,12 @@ class FedConfig:
     # signmv (one-bit OTA majority vote) step magnitude; None = the
     # coordinatewise median of |w_i - guess| (robust adaptive scale)
     sign_eta: Optional[float] = None
+    # dnc (spectral divide-and-conquer) knobs — the paper's defaults:
+    # filtering rounds, coordinate-subsample size, removal multiplier
+    # (ceil(c*B) flagged per round)
+    dnc_iters: int = 3
+    dnc_sub_dim: int = 10000
+    dnc_c: float = 1.0
     # "auto" | "xla" | "pallas": geometric-median Weiszfeld step
     # implementation (pallas = fused single-HBM-pass TPU kernel,
     # ops/pallas_kernels.py).  "auto" resolves to pallas on a real TPU
@@ -201,6 +207,12 @@ class FedConfig:
         )
         assert self.sign_eta is None or self.sign_eta > 0, (
             f"sign_eta must be positive when set, got {self.sign_eta}"
+        )
+        assert (
+            self.dnc_iters >= 1 and self.dnc_sub_dim >= 1 and self.dnc_c > 0
+        ), (
+            f"dnc knobs must be positive, got iters={self.dnc_iters}, "
+            f"sub_dim={self.dnc_sub_dim}, c={self.dnc_c}"
         )
         assert self.fedprox_mu >= 0, (
             f"fedprox_mu must be >= 0, got {self.fedprox_mu}"

@@ -77,6 +77,12 @@ def run_title(cfg: FedConfig) -> str:
         title += f"_ci{cfg.clip_iters}"
     if cfg.sign_eta is not None:
         title += f"_eta{cfg.sign_eta}"
+    if _non_default(cfg, "dnc_iters"):
+        title += f"_di{cfg.dnc_iters}"
+    if _non_default(cfg, "dnc_sub_dim"):
+        title += f"_ds{cfg.dnc_sub_dim}"
+    if _non_default(cfg, "dnc_c"):
+        title += f"_dc{cfg.dnc_c}"
     # implementation knobs that change the TRAJECTORY (not just speed):
     # a non-threefry PRNG stream and a bf16 aggregator stack both produce
     # different results from the default run, so they must not alias with

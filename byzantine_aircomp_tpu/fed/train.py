@@ -360,6 +360,9 @@ class FedTrainer:
                 clip_tau=cfg.clip_tau,
                 clip_iters=cfg.clip_iters,
                 sign_eta=cfg.sign_eta,
+                dnc_iters=cfg.dnc_iters,
+                dnc_sub_dim=cfg.dnc_sub_dim,
+                dnc_c=cfg.dnc_c,
             )
             aggregated = aggregated.astype(jnp.float32)
             if self._server_tx is not None:

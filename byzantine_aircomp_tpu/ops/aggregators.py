@@ -26,6 +26,7 @@ returns one row, like the reference).  All are jit/vmap/shard_map friendly.
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax
@@ -61,10 +62,11 @@ def _finite_centroid(wmatrix, finite):
     # the max(.., 1) only keeps THIS division defined; a stack with zero
     # finite rows is unsupported (the subsequent num/den step divides by
     # den = 0 and the aggregate is NaN regardless — config guarantees
-    # honest rows exist, and honest rows are finite)
-    return jnp.sum(_mask_rows(wmatrix, finite), axis=0) / jnp.maximum(
-        jnp.sum(finite), 1.0
-    )
+    # honest rows exist, and honest rows are finite).  The f32 cast keeps
+    # the ACCUMULATION f32 under --stack-dtype bf16 (fused into the reduce)
+    return jnp.sum(
+        _mask_rows(wmatrix, finite).astype(jnp.float32), axis=0
+    ) / jnp.maximum(jnp.sum(finite), 1.0)
 
 
 @AGGREGATORS.register("mean")
@@ -191,6 +193,93 @@ def multi_krum(
     return _blocked_columns(
         wmatrix, lambda cols: selected_rows_mean(cols, idx, m_sel)
     )
+
+
+@AGGREGATORS.register("dnc")
+def dnc(
+    wmatrix: jnp.ndarray,
+    *,
+    honest_size: int,
+    key: Optional[jax.Array] = None,
+    dnc_iters: int = 3,
+    dnc_sub_dim: int = 10000,
+    dnc_c: float = 1.0,
+    **_,
+) -> jnp.ndarray:
+    """Divide-and-Conquer (Shejwalkar & Houmansadr, NDSS 2021) — the
+    defense proposed alongside the ``minmax``/``minsum`` attacks this
+    framework ships.  Not in the reference.
+
+    Each of ``dnc_iters`` rounds samples ``dnc_sub_dim`` coordinates,
+    centers the [K, r] submatrix, finds its top right-singular vector by
+    power iteration (a fixed-length ``fori_loop`` — jit-static), scores
+    every client by its squared projection, and flags the ceil(c*B)
+    highest scorers.  The aggregate is the mean of clients flagged in NO
+    round.  Coordinate subsampling keeps the spectral step O(K * r) per
+    power step whatever d is — at ResNet scale only the sampled columns
+    are ever gathered.
+
+    Hardening beyond the paper: non-finite rows are excluded from every
+    mean and receive +Inf scores (always flagged); if the surviving set is
+    empty (pathological — the paper assumes K >> c*B*iters) the masked
+    mean degrades to the finite-row centroid rather than NaN.
+    """
+    k, d = wmatrix.shape
+    b = k - honest_size
+    n_remove = math.ceil(dnc_c * b)
+    if n_remove * dnc_iters >= k:
+        raise ValueError(
+            f"dnc removes ceil(c*B)={n_remove} clients per round x "
+            f"{dnc_iters} rounds but K={k}; need K > removals (K >> is the "
+            f"paper's regime) — lower dnc_c/dnc_iters or raise K"
+        )
+    if key is None:
+        key = jax.random.key(0, impl="threefry2x32")
+    finite = _finite_rows(wmatrix)
+    r = min(d, int(dnc_sub_dim))
+    keep = finite
+
+    for it in range(dnc_iters):  # static, small
+        k_cols, k_v = jax.random.split(jax.random.fold_in(key, it))
+        # with-replacement column draw: O(r) memory, vs a full [d]
+        # sort-based permutation (prohibitive in-loop at d ~ 11M); for
+        # r << d the distinction is statistically immaterial (the paper's
+        # subsampling is itself a variance/cost tradeoff)
+        cols = jax.random.randint(k_cols, (r,), 0, d)
+        # f32 from here on, whatever the stack dtype: the centering sum
+        # and the spectral scores must not accumulate in bf16
+        sub = jnp.where(
+            finite[:, None], wmatrix[:, cols], 0.0
+        ).astype(jnp.float32)  # [K, r]
+        centered = sub - jnp.sum(sub, axis=0) / jnp.maximum(
+            jnp.sum(finite), 1.0
+        )
+        centered = jnp.where(finite[:, None], centered, 0.0)
+
+        def power_step(_, v):
+            u = centered @ v  # [K]
+            v2 = centered.T @ u  # [r]
+            return v2 / jnp.maximum(jnp.linalg.norm(v2), 1e-12)
+
+        v0 = jax.random.normal(k_v, (r,), jnp.float32)
+        v0 = v0 / jnp.maximum(jnp.linalg.norm(v0), 1e-12)
+        v = jax.lax.fori_loop(0, 10, power_step, v0)
+
+        scores = (centered @ v) ** 2
+        scores = jnp.where(finite, scores, jnp.inf)
+        if n_remove:
+            _, out_idx = jax.lax.top_k(scores, n_remove)
+            keep = jnp.logical_and(
+                keep, jnp.ones(k, bool).at[out_idx].set(False)
+            )
+
+    kept = jnp.where(keep[:, None], wmatrix, 0.0)
+    count = jnp.sum(keep)
+    # f32 accumulation whatever the stack dtype (cast fuses into the reduce)
+    mean_kept = jnp.sum(kept.astype(jnp.float32), axis=0) / jnp.maximum(
+        count, 1
+    )
+    return jnp.where(count > 0, mean_kept, _finite_centroid(wmatrix, finite))
 
 
 @AGGREGATORS.register("signmv")

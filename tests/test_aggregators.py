@@ -507,3 +507,104 @@ def test_krum_bf16_distances_not_quantization_noise():
         agg.krum_scores(jnp.asarray(w, jnp.bfloat16), honest_size=14)
     )
     assert int(np.argmin(scores)) != 15, scores
+
+
+# ---------------------------------------------------------------------------
+# DnC (Shejwalkar & Houmansadr 2021)
+
+
+def _outlier_stack(b=3, k=14, d=120, shift=6.0, seed=21):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(k, d)).astype(np.float32) * 0.1
+    direction = rng.normal(size=d).astype(np.float32)
+    direction /= np.linalg.norm(direction)
+    w[-b:] += shift * direction  # coordinated outliers along one direction
+    return w, b
+
+
+def test_dnc_flags_coordinated_outliers():
+    # the aggregate must be ~ the honest mean, not dragged by the planted
+    # direction: DnC's spectral score is built for exactly this geometry
+    w, b = _outlier_stack()
+    out = np.asarray(agg.dnc(
+        jnp.asarray(w), honest_size=len(w) - b, key=jax.random.key(1)
+    ))
+    honest_mean = w[:-b].mean(axis=0)
+    attacked_mean = w.mean(axis=0)
+    assert np.linalg.norm(out - honest_mean) < 0.2 * np.linalg.norm(
+        attacked_mean - honest_mean
+    )
+
+
+def test_dnc_matches_numpy_oracle_selection():
+    # distributional agreement: on a well-separated stack both
+    # implementations must land on (approximately) the honest mean
+    w, b = _outlier_stack(seed=22)
+    got = np.asarray(agg.dnc(
+        jnp.asarray(w), honest_size=len(w) - b, key=jax.random.key(2)
+    ))
+    want = numpy_ref.dnc(w, len(w) - b, np.random.default_rng(3))
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
+
+
+def test_dnc_no_byzantine_is_mean():
+    rng = np.random.default_rng(5)
+    w = rng.normal(size=(10, 50)).astype(np.float32)
+    out = np.asarray(agg.dnc(jnp.asarray(w), honest_size=10,
+                             key=jax.random.key(4)))
+    np.testing.assert_allclose(out, w.mean(axis=0), rtol=1e-5, atol=1e-6)
+
+
+def test_dnc_excludes_nonfinite_rows():
+    w, b = _outlier_stack()
+    w[-1] = np.inf
+    out = np.asarray(agg.dnc(
+        jnp.asarray(w), honest_size=len(w) - b, key=jax.random.key(6)
+    ))
+    assert np.isfinite(out).all()
+
+
+def test_dnc_rejects_pathological_removal_count():
+    w = np.zeros((6, 10), np.float32)
+    with pytest.raises(ValueError, match="dnc removes"):
+        agg.dnc(jnp.asarray(w), honest_size=2, key=jax.random.key(0))
+
+
+def test_dnc_subsampled_coordinates_still_flags():
+    # r < d: the column-subsample branch (the ResNet-scale mechanism) must
+    # still isolate coordinated outliers from a 32-coordinate view
+    w, b = _outlier_stack(d=120, seed=23)
+    out = np.asarray(agg.dnc(
+        jnp.asarray(w), honest_size=len(w) - b, key=jax.random.key(7),
+        dnc_sub_dim=32,
+    ))
+    honest_mean = w[:-b].mean(axis=0)
+    attacked_mean = w.mean(axis=0)
+    assert np.linalg.norm(out - honest_mean) < 0.2 * np.linalg.norm(
+        attacked_mean - honest_mean
+    )
+
+
+def test_dnc_oracle_rejects_pathological_count_like_jax():
+    # config-validity parity: both backends refuse the same degenerate case
+    w = np.zeros((6, 10), np.float32)
+    with pytest.raises(ValueError, match="dnc removes"):
+        numpy_ref.dnc(w, honest_size=2, rng=np.random.default_rng(0))
+
+
+def test_dnc_knobs_reach_aggregator():
+    # dnc_c changes how many rows are flagged -> different aggregate
+    w, b = _outlier_stack(b=4, k=16, shift=0.5, seed=24)  # soft outliers
+    kw = dict(honest_size=12, key=jax.random.key(8))
+    a = np.asarray(agg.dnc(jnp.asarray(w), dnc_c=0.25, **kw))
+    c = np.asarray(agg.dnc(jnp.asarray(w), dnc_c=1.0, **kw))
+    assert not np.allclose(a, c)
+
+
+def test_dnc_bf16_stack_accumulates_f32():
+    w, b = _outlier_stack(seed=25)
+    kw = dict(honest_size=len(w) - b, key=jax.random.key(9))
+    f32 = np.asarray(agg.dnc(jnp.asarray(w), **kw))
+    b16 = np.asarray(agg.dnc(jnp.asarray(w, jnp.bfloat16), **kw))
+    assert b16.dtype == np.float32
+    np.testing.assert_allclose(b16, f32, rtol=2e-2, atol=2e-2)

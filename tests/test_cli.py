@@ -172,6 +172,10 @@ def test_run_titles_distinct_across_extension_knobs():
         dict(partition="dirichlet"),
         dict(partition="dirichlet", dirichlet_alpha=0.1),
         dict(participation=0.5),
+        dict(agg="dnc"),
+        dict(agg="dnc", dnc_c=0.5),
+        dict(agg="dnc", dnc_iters=5),
+        dict(agg="dnc", dnc_sub_dim=500),
     ]
     titles = [
         run_title(FedConfig(honest_size=8, **v)) for v in variants

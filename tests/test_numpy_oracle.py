@@ -111,3 +111,19 @@ def test_ref_backend_partial_participation_runs_and_learns():
         log_fn=lambda s: None, dataset=ds,
     )
     assert rec["valAccPath"][-1] > 0.3, rec["valAccPath"]
+
+
+def test_ref_backend_dnc_runs():
+    from byzantine_aircomp_tpu.backends.ref_trainer import run_ref
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+
+    ds = data_lib.load("mnist", synthetic_train=1000, synthetic_val=200)
+    rec = run_ref(
+        FedConfig(
+            honest_size=10, byz_size=2, attack="signflip", agg="dnc",
+            rounds=3, display_interval=5, batch_size=8, eval_train=False,
+        ),
+        log_fn=lambda s: None, dataset=ds,
+    )
+    assert rec["valAccPath"][-1] > 0.3, rec["valAccPath"]

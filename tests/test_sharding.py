@@ -79,6 +79,8 @@ def test_air_sum_equals_oma2(noise_var, model_parallel):
         ("cclip", None),
         # one-bit OTA majority vote, incl. its receiver noise on the votes
         ("signmv", 1e-3),
+        # spectral outlier scoring: column gather + power iteration under GSPMD
+        ("dnc", None),
     ],
 )
 def test_sharded_trainer_matches_single_device(agg, noise_var, model_parallel):
