@@ -205,7 +205,8 @@ def dnc(
         centered = np.where(finite[:, None], centered, 0.0)
         _, _, vt = np.linalg.svd(centered, full_matrices=False)
         scores = (centered @ vt[0]) ** 2
-        scores = np.where(finite, scores, np.inf)
+        # -Inf, as the jax path: the removal budget targets live rows
+        scores = np.where(finite, scores, -np.inf)
         if n_remove:
             keep[np.argsort(scores)[-n_remove:]] = False
     if keep.any():

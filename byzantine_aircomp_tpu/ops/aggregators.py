@@ -219,9 +219,11 @@ def dnc(
     power step whatever d is — at ResNet scale only the sampled columns
     are ever gathered.
 
-    Hardening beyond the paper: non-finite rows are excluded from every
-    mean and receive +Inf scores (always flagged); if the surviving set is
-    empty (pathological — the paper assumes K >> c*B*iters) the masked
+    Hardening beyond the paper: non-finite rows are force-excluded from
+    the surviving set up front and scored -Inf, so the removal budget is
+    spent on live rows (an overflowed Byzantine row must not shield its
+    finite accomplices by winning top_k every round); if the surviving set
+    is empty (pathological — the paper assumes K >> c*B*iters) the masked
     mean degrades to the finite-row centroid rather than NaN.
     """
     k, d = wmatrix.shape
@@ -266,19 +268,19 @@ def dnc(
         v = jax.lax.fori_loop(0, 10, power_step, v0)
 
         scores = (centered @ v) ** 2
-        scores = jnp.where(finite, scores, jnp.inf)
+        # non-finite rows are already force-excluded (keep starts at
+        # `finite`); score them -Inf so the ceil(c*B) removal budget is
+        # spent on LIVE rows — +Inf would make an overflowed Byzantine row
+        # win top_k every round and shield its finite accomplices
+        scores = jnp.where(finite, scores, -jnp.inf)
         if n_remove:
             _, out_idx = jax.lax.top_k(scores, n_remove)
             keep = jnp.logical_and(
                 keep, jnp.ones(k, bool).at[out_idx].set(False)
             )
 
-    kept = jnp.where(keep[:, None], wmatrix, 0.0)
     count = jnp.sum(keep)
-    # f32 accumulation whatever the stack dtype (cast fuses into the reduce)
-    mean_kept = jnp.sum(kept.astype(jnp.float32), axis=0) / jnp.maximum(
-        count, 1
-    )
+    mean_kept = _finite_centroid(wmatrix, keep)
     return jnp.where(count > 0, mean_kept, _finite_centroid(wmatrix, finite))
 
 

@@ -608,3 +608,28 @@ def test_dnc_bf16_stack_accumulates_f32():
     b16 = np.asarray(agg.dnc(jnp.asarray(w, jnp.bfloat16), **kw))
     assert b16.dtype == np.float32
     np.testing.assert_allclose(b16, f32, rtol=2e-2, atol=2e-2)
+
+
+def test_dnc_inf_row_does_not_shield_finite_outliers():
+    # regression: an overflowed Byzantine row used to score +Inf and win
+    # top_k every round, spending the whole removal budget on a row that
+    # keep=finite already excluded — its finite accomplices escaped.  With
+    # n_remove=1 (dnc_c=1/3, B=3) the budget must go to the LIVE outliers
+    w, b = _outlier_stack(b=3, k=12, seed=26)
+    w[-1] = np.inf  # one overflowed, two finite coordinated outliers
+    out = np.asarray(agg.dnc(
+        jnp.asarray(w), honest_size=len(w) - b, key=jax.random.key(10),
+        dnc_c=1.0 / 3.0,
+    ))
+    honest_mean = w[:-b].mean(axis=0)
+    # the mean over finite rows INCLUDING the two live outliers — where the
+    # aggregate lands if the budget is wasted on the Inf row
+    poisoned_mean = w[:-1].mean(axis=0)
+    gap = np.linalg.norm(poisoned_mean - honest_mean)
+    assert np.isfinite(out).all()
+    assert np.linalg.norm(out - honest_mean) < 0.6 * gap
+    # oracle: same budget semantics
+    want = numpy_ref.dnc(
+        w, len(w) - b, np.random.default_rng(4), dnc_c=1.0 / 3.0
+    )
+    assert np.linalg.norm(want - honest_mean) < 0.6 * gap

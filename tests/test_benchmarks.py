@@ -54,3 +54,23 @@ def test_model_bench_tiny_rung_end_to_end():
     assert rec["K"] == 8 and rec["B"] == 1
     assert rec["batch_size"] == 8 and rec["display_interval"] == 2
     assert rec["value"] > 0
+
+
+def test_impl_ab_bench_rejects_unknown_variant_fast():
+    r = _run("impl_ab_bench.py", "--variants", "nope", timeout=120)
+    assert r.returncode != 0 and "unknown variants" in r.stderr
+
+
+@pytest.mark.slow
+def test_impl_ab_bench_tiny_baseline_end_to_end():
+    """One tiny baseline block through the real script: a JSON record with
+    per-block rates must come out (the A/B methodology's unit)."""
+    r = _run(
+        "impl_ab_bench.py", "--variants", "agg_xla",
+        "--warmup-rounds", "1", "--timed-rounds", "1", "--blocks", "2",
+        timeout=900,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "ab_rounds_per_sec_agg_xla"
+    assert len(rec["blocks"]) == 2 and all(b > 0 for b in rec["blocks"])
