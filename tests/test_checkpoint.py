@@ -8,8 +8,8 @@ from byzantine_aircomp_tpu.fed.train import FedTrainer
 from byzantine_aircomp_tpu.data import datasets as data_lib
 
 
-def _cfg(rounds):
-    return FedConfig(
+def _cfg(rounds, **kw):
+    base = dict(
         honest_size=6,
         rounds=rounds,
         display_interval=3,
@@ -17,6 +17,30 @@ def _cfg(rounds):
         agg="mean",
         eval_train=False,
     )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _assert_resume_matches_uninterrupted(tmp_path, make_cfg):
+    """Shared harness: interrupted-at-round-2 + resume == 4 straight rounds."""
+    ds = data_lib.load("mnist", synthetic_train=1500, synthetic_val=300)
+
+    t_full = FedTrainer(make_cfg(), dataset=ds)
+    t_full.train()
+    full = np.asarray(t_full.flat_params)
+
+    t_a = FedTrainer(make_cfg(), dataset=ds)
+    for r in range(2):
+        t_a.run_round(r)
+    checkpoint.save(str(tmp_path), "t", 2, t_a.flat_params)
+
+    r0, flat, _ = checkpoint.load(str(tmp_path), "t")
+    t_b = FedTrainer(make_cfg(), dataset=ds)
+    t_b.flat_params = np.asarray(flat)
+    for r in range(r0, 4):
+        t_b.run_round(r)
+
+    np.testing.assert_allclose(np.asarray(t_b.flat_params), full, atol=1e-6)
 
 
 def test_save_load_round_trip(tmp_path):
@@ -29,23 +53,15 @@ def test_save_load_round_trip(tmp_path):
 
 
 def test_resume_matches_uninterrupted(tmp_path):
-    ds = data_lib.load("mnist", synthetic_train=1500, synthetic_val=300)
+    _assert_resume_matches_uninterrupted(tmp_path, lambda: _cfg(4))
 
-    # uninterrupted: 4 rounds
-    t_full = FedTrainer(_cfg(4), dataset=ds)
-    t_full.train()
-    full = np.asarray(t_full.flat_params)
 
-    # interrupted: 2 rounds, checkpoint, fresh trainer resumes rounds 2..4
-    t_a = FedTrainer(_cfg(4), dataset=ds)
-    for r in range(2):
-        t_a.run_round(r)
-    checkpoint.save(str(tmp_path), "t", 2, t_a.flat_params)
-
-    r0, flat, _ = checkpoint.load(str(tmp_path), "t")
-    t_b = FedTrainer(_cfg(4), dataset=ds)
-    t_b.flat_params = np.asarray(flat)
-    for r in range(r0, 4):
-        t_b.run_round(r)
-
-    np.testing.assert_allclose(np.asarray(t_b.flat_params), full, atol=1e-6)
+def test_resume_matches_uninterrupted_with_participation(tmp_path):
+    # the per-iteration participant draw derives from the same
+    # fold_in(round) key stream, so resume-from-round-r must replay the
+    # identical participant sequence
+    _assert_resume_matches_uninterrupted(
+        tmp_path,
+        lambda: _cfg(4, honest_size=8, agg="gm2", participation=0.5,
+                     agg_maxiter=50),
+    )

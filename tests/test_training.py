@@ -356,3 +356,15 @@ def test_participation_validation():
         # 0.1 * 3 byz rounds to 0 — must refuse, not silently drop the attack
         make_cfg(honest_size=9, byz_size=3, attack="weightflip",
                  participation=0.1).validate()
+
+
+def test_all_new_knobs_compose():
+    # non-IID split + partial participation + bf16 stack + dnc in ONE run:
+    # the framework's extension knobs must not be pairwise-only features
+    paths = run_short(make_cfg(
+        agg="dnc", honest_size=12, byz_size=3, attack="alie",
+        partition="dirichlet", dirichlet_alpha=0.5, participation=2 / 3,
+        stack_dtype="bf16", rounds=2,
+    ))
+    assert np.isfinite(paths["valAccPath"]).all()
+    assert paths["valAccPath"][-1] > 0.3, paths["valAccPath"]
