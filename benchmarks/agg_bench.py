@@ -74,7 +74,7 @@ def main():
     )
     cases = []
     for name in ["mean", "median", "trimmed_mean", "krum", "multi_krum",
-                 "bulyan", "cclip", "signmv", "gm2", "gm"]:
+                 "bulyan", "cclip", "signmv", "dnc", "gm2", "gm"]:
         impls = ["xla"]
         if name in ("gm", "gm2") and not args.skip_pallas:
             from byzantine_aircomp_tpu.ops import pallas_kernels

@@ -190,6 +190,7 @@ def main(argv=None) -> None:
         partition=args.partition,
         dirichlet_alpha=args.dirichlet_alpha,
         participation=args.participation,
+        bucket_size=args.bucket_size,
         attack_param=args.attack_param,
         krum_m=args.krum_m,
         clip_tau=args.clip_tau,

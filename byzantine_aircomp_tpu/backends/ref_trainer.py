@@ -382,10 +382,25 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
             if cfg.noise_var is not None and cfg.agg not in ("gm", "signmv"):
                 w_stack = numpy_ref.oma(rng, w_stack, cfg.noise_var)
 
+            # bucketing (fed/train.py's bucketing scope): aggregate the
+            # [m/s, d] random-bucket means with the worst-case clean count
+            agg_stack, agg_h = w_stack, part_h
+            if cfg.bucket_size > 1:
+                s_b = cfg.bucket_size
+                m_rows = len(w_stack)
+                bperm = rng.permutation(m_rows)
+                agg_stack = (
+                    w_stack[bperm]
+                    .reshape(m_rows // s_b, s_b, -1)
+                    .mean(axis=1)
+                    .astype(np.float32)
+                )
+                agg_h = m_rows // s_b - part_b
+
             if cfg.agg == "gm":
                 agg_out = numpy_ref.gm(
                     rng,
-                    w_stack,
+                    agg_stack,
                     noise_var=cfg.noise_var,
                     guess=flat,
                     maxiter=cfg.agg_maxiter,
@@ -394,33 +409,33 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
                 ).astype(np.float32)
             elif cfg.agg == "gm2":
                 agg_out = numpy_ref.gm2(
-                    w_stack, guess=flat, maxiter=cfg.agg_maxiter, tol=cfg.agg_tol
+                    agg_stack, guess=flat, maxiter=cfg.agg_maxiter, tol=cfg.agg_tol
                 ).astype(np.float32)
             elif cfg.agg == "mean":
-                agg_out = numpy_ref.mean(w_stack)
+                agg_out = numpy_ref.mean(agg_stack)
             elif cfg.agg == "median":
-                agg_out = numpy_ref.median(w_stack)
+                agg_out = numpy_ref.median(agg_stack)
             elif cfg.agg == "trimmed_mean":
-                agg_out = numpy_ref.trimmed_mean(w_stack)
+                agg_out = numpy_ref.trimmed_mean(agg_stack)
             elif cfg.agg in ("krum", "Krum"):
-                agg_out = numpy_ref.krum(w_stack, part_h).copy()
+                agg_out = numpy_ref.krum(agg_stack, agg_h).copy()
             elif cfg.agg == "multi_krum":
-                agg_out = numpy_ref.multi_krum(w_stack, part_h, m=cfg.krum_m)
+                agg_out = numpy_ref.multi_krum(agg_stack, agg_h, m=cfg.krum_m)
             elif cfg.agg == "bulyan":
-                agg_out = numpy_ref.bulyan(w_stack, part_h)
+                agg_out = numpy_ref.bulyan(agg_stack, agg_h)
             elif cfg.agg == "cclip":
                 agg_out = numpy_ref.centered_clip(
-                    w_stack, guess=flat,
+                    agg_stack, guess=flat,
                     clip_tau=cfg.clip_tau, clip_iters=cfg.clip_iters,
                 )
             elif cfg.agg == "dnc":
                 agg_out = numpy_ref.dnc(
-                    w_stack, part_h, rng, dnc_iters=cfg.dnc_iters,
+                    agg_stack, agg_h, rng, dnc_iters=cfg.dnc_iters,
                     dnc_sub_dim=cfg.dnc_sub_dim, dnc_c=cfg.dnc_c,
                 )
             elif cfg.agg == "signmv":
                 agg_out = numpy_ref.sign_majority_vote(
-                    w_stack, guess=flat, noise_var=cfg.noise_var,
+                    agg_stack, guess=flat, noise_var=cfg.noise_var,
                     sign_eta=cfg.sign_eta, rng=rng,
                 )
             else:

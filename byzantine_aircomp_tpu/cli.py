@@ -39,6 +39,10 @@ def add_knob_flags(p) -> None:
                    help="fraction of clients active per iteration "
                         "(stratified honest/Byzantine draw; 1.0 = all, "
                         "the reference's behavior)")
+    p.add_argument("--bucket-size", type=int, default=1,
+                   help="server-side bucketing (Karimireddy 2022): "
+                        "aggregate means of random s-client buckets — the "
+                        "standard non-IID fix for median/krum; 1 = off")
     p.add_argument("--attack-param", type=float, default=None,
                    help="scalar attack magnitude (alie z / ipm eps / gaussian "
                         "sigma / minmax+minsum fixed gamma)")
@@ -75,6 +79,7 @@ ARG_TO_FIELD = {
     "partition": ("partition", None),
     "dirichlet_alpha": ("dirichlet_alpha", None),
     "participation": ("participation", None),
+    "bucket_size": ("bucket_size", None),
     "attack_param": ("attack_param", None),
     "krum_m": ("krum_m", None),
     "clip_tau": ("clip_tau", None),

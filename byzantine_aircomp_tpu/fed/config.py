@@ -121,6 +121,16 @@ class FedConfig:
     # aggregators' honest_size contract) exact with static shapes; 1.0
     # (default) is bit-identical to the full-participation program
     participation: float = 1.0
+    # bucketing (Karimireddy, He & Jaggi, ICLR 2022): before robust
+    # aggregation the server averages random disjoint buckets of s client
+    # messages and aggregates the [m/s, d] bucket means instead — the
+    # canonical remedy for the attack-free collapse of coordinatewise/
+    # selection defenses (median/krum/signmv) under non-IID clients (see
+    # docs/RESULTS.md's Dirichlet matrix): bucket means concentrate
+    # around the true mean while at most one Byzantine row contaminates
+    # each bucket.  1 = off (reference behavior); the participating
+    # client count must be divisible by s
+    bucket_size: int = 1
 
     def participant_counts(self) -> tuple:
         """(honest, Byzantine) rows per iteration — the single source of
@@ -194,9 +204,40 @@ class FedConfig:
         assert self.stack_dtype in ("f32", "bf16"), (
             f"stack_dtype must be 'f32' or 'bf16', got {self.stack_dtype!r}"
         )
+        assert self.bucket_size >= 1, (
+            f"bucket_size must be >= 1, got {self.bucket_size}"
+        )
+        if self.bucket_size > 1:
+            m = part_h + part_b
+            assert m % self.bucket_size == 0, (
+                f"bucket_size {self.bucket_size} must divide the "
+                f"{m} participating clients"
+            )
+            n_buckets = m // self.bucket_size
+            clean = n_buckets - part_b  # worst case: one byz row per bucket
+            assert clean >= 2, (
+                f"bucketing leaves {n_buckets} buckets of which {part_b} "
+                f"may be Byzantine-contaminated — {clean} worst-case clean "
+                f"buckets is degenerate; use a smaller bucket_size or "
+                f"fewer Byzantine clients"
+            )
+            assert not (self.agg in ("krum", "Krum", "multi_krum") and clean < 3), (
+                f"krum needs >= 3 worst-case clean buckets to score "
+                f"neighbors (got {clean}); smaller bucket_size required"
+            )
+            # gm/signmv transmit INSIDE their aggregation (the AirComp
+            # sum is per Weiszfeld step / per vote) — there are no
+            # received per-client messages for the server to bucket, so
+            # the combination has no physical meaning
+            assert self.agg not in ("gm", "signmv"), (
+                f"bucketing is undefined for agg={self.agg!r}: its "
+                f"over-the-air transmission happens inside aggregation; "
+                f"use gm2 (ideal) or a prepass aggregator"
+            )
         # aggregators see round(f*H) + round(f*B) rows under partial
-        # participation, so selection counts are bounded by that, not K
-        eff_k = part_h + part_b
+        # participation — or m/s bucket means under bucketing — so
+        # selection counts are bounded by that, not K
+        eff_k = (part_h + part_b) // self.bucket_size
         assert self.krum_m is None or 1 <= self.krum_m <= eff_k, (
             f"krum_m must be in [1, {eff_k}] (participating clients), "
             f"got {self.krum_m}"

@@ -91,6 +91,8 @@ def run_title(cfg: FedConfig) -> str:
         title += f"_dir{cfg.dirichlet_alpha}"
     if cfg.participation < 1.0:
         title += f"_part{cfg.participation}"
+    if cfg.bucket_size > 1:
+        title += f"_bkt{cfg.bucket_size}"
     if _non_default(cfg, "prng_impl"):
         title += f"_prng{cfg.prng_impl}"
     if _non_default(cfg, "stack_dtype"):

@@ -281,12 +281,18 @@ class FedTrainer:
         cfg = self.cfg
         flat_params, opt_state = carry
         m_h, m_b = self._part_h, self._part_b
+        # extra keys exist only on the programs that need them, so the
+        # default configuration consumes the exact default RNG stream
+        # (checkpoint/replay compatible)
+        n_extra = int(cfg.participation < 1.0) + int(cfg.bucket_size > 1)
+        keys = jax.random.split(key, 4 + n_extra)
+        k_batch, k_chan, k_agg, k_msg = keys[:4]
+        next_extra = 4
         if cfg.participation < 1.0:
             # stratified participant draw: m_h of the honest, m_b of the
-            # Byzantine, fresh every iteration.  The extra key split only
-            # exists on this program, so participation=1.0 consumes the
-            # exact default RNG stream (checkpoint/replay compatible)
-            k_batch, k_chan, k_agg, k_msg, k_part = jax.random.split(key, 5)
+            # Byzantine, fresh every iteration
+            k_part = keys[next_extra]
+            next_extra += 1
             kh, kb = jax.random.split(k_part)
             part = jax.random.permutation(kh, cfg.honest_size)[:m_h]
             if m_b:
@@ -298,8 +304,9 @@ class FedTrainer:
             offsets = self.offsets[part]
             sizes = self.sizes[part]
         else:
-            k_batch, k_chan, k_agg, k_msg = jax.random.split(key, 4)
             offsets, sizes = self.offsets, self.sizes
+        if cfg.bucket_size > 1:
+            k_bucket = keys[next_extra]
 
         with jax.named_scope("client_local_step"):
             # E local steps per client, each on a fresh with-replacement
@@ -340,15 +347,42 @@ class FedTrainer:
             if cfg.noise_var is not None and agg_lib.needs_oma_prepass(cfg.agg):
                 w_stack = channel_lib.oma(k_chan, w_stack, cfg.noise_var)
 
+        agg_honest = m_h
+        w_for_agg = w_stack
+        if cfg.bucket_size > 1:
+            with jax.named_scope("bucketing"):
+                # Karimireddy 2022: aggregate [m/s, d] random-bucket means.
+                # A non-finite row poisons its bucket's mean, which the
+                # aggregators' non-finite-row exclusion then drops — an
+                # overflowed attack costs its bucket, nothing more.  The
+                # aggregator's honest count becomes the WORST-CASE clean
+                # bucket count (every Byzantine row in a distinct bucket).
+                # segment_sum reads the stack ONCE and writes [m/s, d] —
+                # w_stack[perm] would materialize a second full [m, d]
+                # copy (~tens of GB at the ResNet rung)
+                s = cfg.bucket_size
+                m = m_h + m_b
+                perm = jax.random.permutation(k_bucket, m)
+                bucket_ids = jnp.zeros(m, jnp.int32).at[perm].set(
+                    jnp.arange(m, dtype=jnp.int32) // s
+                )
+                w_for_agg = (
+                    jax.ops.segment_sum(
+                        w_stack, bucket_ids, num_segments=m // s
+                    )
+                    / s
+                )
+                agg_honest = m // s - m_b
+
         with jax.named_scope("aggregate"):
             # --stack-dtype bf16: hand the aggregator a bf16 view of the
             # stack (halves its per-Weiszfeld-iteration HBM reads);
             # arithmetic stays f32 via promotion / in-kernel upcast, and
             # the aggregate is cast back so the params carry stays f32
-            w_agg = w_stack.astype(self._stack_dtype)
+            w_agg = w_for_agg.astype(self._stack_dtype)
             aggregated = self.agg_fn(
                 w_agg,
-                honest_size=m_h,
+                honest_size=agg_honest,
                 key=k_agg,
                 noise_var=cfg.noise_var,
                 guess=flat_params,

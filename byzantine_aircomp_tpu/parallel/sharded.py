@@ -53,6 +53,14 @@ class ShardedFedTrainer(FedTrainer):
                     f"mesh axis ({n_clients_axis}); pick a fraction whose "
                     f"participant count divides the mesh"
                 )
+        if cfg.bucket_size > 1:
+            n_buckets = sum(cfg.participant_counts()) // cfg.bucket_size
+            if n_buckets % n_clients_axis:
+                raise ValueError(
+                    f"bucket_size {cfg.bucket_size} leaves {n_buckets} "
+                    f"buckets, not divisible by the "
+                    f"'{mesh_lib.CLIENT_AXIS}' mesh axis ({n_clients_axis})"
+                )
         super().__init__(cfg, dataset=dataset)
 
         # GSPMD has no partitioning rule for pallas_call: with the [K, d]

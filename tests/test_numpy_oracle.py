@@ -127,3 +127,20 @@ def test_ref_backend_dnc_runs():
         log_fn=lambda s: None, dataset=ds,
     )
     assert rec["valAccPath"][-1] > 0.3, rec["valAccPath"]
+
+
+def test_ref_backend_bucketing_runs_and_differs():
+    from byzantine_aircomp_tpu.backends.ref_trainer import run_ref
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+
+    ds = data_lib.load("mnist", synthetic_train=1000, synthetic_val=200)
+    kw = dict(honest_size=10, byz_size=2, attack="weightflip", agg="krum",
+              rounds=2, display_interval=5, batch_size=8, eval_train=False)
+    quiet = lambda s: None
+    plain = run_ref(FedConfig(**kw), log_fn=quiet, dataset=ds)
+    # s=2 -> 6 buckets, worst case 2 dirty, honest count 4 (s=3 would
+    # leave krum a degenerate honest count of 2)
+    bkt = run_ref(FedConfig(bucket_size=2, **kw), log_fn=quiet, dataset=ds)
+    assert plain["valAccPath"] != bkt["valAccPath"]
+    assert bkt["valAccPath"][-1] > 0.3, bkt["valAccPath"]

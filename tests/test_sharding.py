@@ -310,3 +310,23 @@ def test_sharded_partial_participation_runs():
     )
     tr.run_round(0)
     assert np.isfinite(np.asarray(tr.flat_params)).all()
+
+
+def test_sharded_bucketing_matches_single_device():
+    # 16 participants, buckets of 2 -> 8 bucket rows over the 8-device axis
+    ds = data_lib.load("mnist", synthetic_train=1600, synthetic_val=320)
+    kw = dict(
+        honest_size=13, byz_size=3, attack="classflip", rounds=2,
+        display_interval=3, batch_size=16, agg="gm2", eval_train=False,
+        agg_maxiter=50, bucket_size=2,
+    )
+    single = FedTrainer(FedConfig(**kw), dataset=ds)
+    sharded = ShardedFedTrainer(
+        FedConfig(**kw), dataset=ds, mesh=mesh_lib.make_mesh()
+    )
+    single.run_round(0)
+    sharded.run_round(0)
+    np.testing.assert_allclose(
+        np.asarray(single.flat_params), np.asarray(sharded.flat_params),
+        rtol=5e-4, atol=5e-6,
+    )

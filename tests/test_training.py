@@ -368,3 +368,67 @@ def test_all_new_knobs_compose():
     ))
     assert np.isfinite(paths["valAccPath"]).all()
     assert paths["valAccPath"][-1] > 0.3, paths["valAccPath"]
+
+
+def test_bucketing_rescues_median_under_label_skew():
+    # the motivating claim (Karimireddy 2022 + docs/RESULTS.md non-IID
+    # matrix): coordinatewise median collapses on dirichlet-skewed clients
+    # with NO attacker; averaging random 3-client buckets first restores it
+    kw = dict(agg="median", honest_size=12, byz_size=0,
+              partition="dirichlet", dirichlet_alpha=0.1, rounds=3, seed=11)
+    plain = run_short(make_cfg(**kw))
+    bucketed = run_short(make_cfg(bucket_size=3, **kw))
+    assert bucketed["valAccPath"][-1] > plain["valAccPath"][-1] + 0.1, (
+        plain["valAccPath"], bucketed["valAccPath"])
+
+
+def test_bucketing_preserves_mean():
+    # mean of equal-size bucket means == overall mean: bucketing must be
+    # exactly transparent to the mean aggregator (up to float association)
+    kw = dict(agg="mean", honest_size=12, rounds=2, seed=12)
+    a = run_short(make_cfg(**kw))
+    b = run_short(make_cfg(bucket_size=3, **kw))
+    np.testing.assert_allclose(a["valLossPath"], b["valLossPath"],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bucketing_gm2_survives_weightflip():
+    # 12 clients, 2 byz, buckets of 2 -> 6 buckets, worst case 2 dirty:
+    # the adjusted honest count must keep gm2's defense intact
+    paths = run_short(make_cfg(
+        agg="gm2", honest_size=10, byz_size=2, attack="weightflip",
+        bucket_size=2, rounds=3,
+    ))
+    assert paths["valAccPath"][-1] > 0.4, paths["valAccPath"]
+
+
+def test_bucketing_validation():
+    with pytest.raises(AssertionError, match="divide"):
+        make_cfg(honest_size=10, bucket_size=3).validate()
+    with pytest.raises(AssertionError, match="buckets"):
+        # 12 clients, 4 byz, s=4 -> 3 buckets, not > 4 contaminated
+        make_cfg(honest_size=8, byz_size=4, attack="weightflip",
+                 bucket_size=4).validate()
+
+
+def test_bucketing_rejects_aircomp_internal_aggregators():
+    # gm/signmv transmit inside aggregation — nothing exists server-side
+    # to bucket; the combination must be refused, not silently mismodeled
+    with pytest.raises(AssertionError, match="undefined"):
+        make_cfg(agg="gm", bucket_size=2, honest_size=12).validate()
+    with pytest.raises(AssertionError, match="undefined"):
+        make_cfg(agg="signmv", bucket_size=2, honest_size=12).validate()
+
+
+def test_bucketing_rejects_degenerate_krum_counts():
+    # 4 honest + 2 byz, s=2 -> 3 buckets, 1 worst-case clean: degenerate
+    with pytest.raises(AssertionError, match="clean"):
+        make_cfg(honest_size=4, byz_size=2, attack="weightflip",
+                 agg="gm2", bucket_size=2).validate()
+    # krum needs >= 3 clean buckets: 8+2, s=2 -> 5 buckets, 3 clean OK...
+    make_cfg(honest_size=8, byz_size=2, attack="weightflip",
+             agg="krum", bucket_size=2).validate()
+    # ...but 6+2, s=2 -> 4 buckets, 2 clean is refused for krum
+    with pytest.raises(AssertionError, match="krum"):
+        make_cfg(honest_size=6, byz_size=2, attack="weightflip",
+                 agg="krum", bucket_size=2).validate()
