@@ -40,9 +40,12 @@ def test_overrides_win():
 def test_preset_runs_one_round_tiny(name):
     """Shrink topology/schedule, keep model/attack/agg/channel semantics."""
     has_attack = presets.PRESETS[name].get("attack") is not None
+    # bucketed presets need enough shrunk participants for >= 2 worst-case
+    # clean buckets (6 participants / s=2 -> 3 buckets, 2 clean)
+    bucketed = presets.PRESETS[name].get("bucket_size", 1) > 1
     cfg = presets.get(
         name,
-        honest_size=3,
+        honest_size=5 if bucketed else 3,
         byz_size=1 if has_attack else 0,
         rounds=1,
         display_interval=1,
