@@ -191,6 +191,7 @@ def main(argv=None) -> None:
         dirichlet_alpha=args.dirichlet_alpha,
         participation=args.participation,
         bucket_size=args.bucket_size,
+        client_momentum=args.client_momentum,
         attack_param=args.attack_param,
         krum_m=args.krum_m,
         clip_tau=args.clip_tau,

@@ -320,6 +320,10 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
     # RNG streams differ across backends as everywhere else — parity on
     # participation configs is distributional, not bitwise)
     part_h, part_b = cfg.participant_counts()
+    # client momentum buffer (cfg.client_momentum doc): [K, d], zeros init
+    client_m = (
+        np.zeros((k, flat.size), np.float32) if cfg.client_momentum else None
+    )
     for r in range(cfg.rounds):
         t0 = time.perf_counter()
         for _ in range(cfg.display_interval):
@@ -348,6 +352,16 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
                     g = model.grad(w_c, xb, yb)
                     if node >= byz0 and cfg.attack == "gradascent":
                         g = -g
+                    if client_m is not None:
+                        # momentum-SGD client step (local_steps == 1 by
+                        # validation): m <- beta*m + (1-beta)*(g + wd*w)
+                        beta = cfg.client_momentum
+                        g_tot = g + cfg.weight_decay * w_c
+                        client_m[node] = (
+                            beta * client_m[node] + (1.0 - beta) * g_tot
+                        )
+                        w_c = flat - cfg.gamma * client_m[node]
+                        continue
                     if cfg.fedprox_mu:
                         g = g + cfg.fedprox_mu * (w_c - flat)
                     w_c = w_c - cfg.gamma * (g + cfg.weight_decay * w_c)

@@ -39,6 +39,10 @@ def add_knob_flags(p) -> None:
                    help="fraction of clients active per iteration "
                         "(stratified honest/Byzantine draw; 1.0 = all, "
                         "the reference's behavior)")
+    p.add_argument("--client-momentum", type=float, default=0.0,
+                   help="client-side momentum beta (Karimireddy 2021 — "
+                        "breaks time-coupled attacks; requires "
+                        "local_steps=1); 0 = off")
     p.add_argument("--bucket-size", type=int, default=1,
                    help="server-side bucketing (Karimireddy 2022): "
                         "aggregate means of random s-client buckets — the "
@@ -80,6 +84,7 @@ ARG_TO_FIELD = {
     "dirichlet_alpha": ("dirichlet_alpha", None),
     "participation": ("participation", None),
     "bucket_size": ("bucket_size", None),
+    "client_momentum": ("client_momentum", None),
     "attack_param": ("attack_param", None),
     "krum_m": ("krum_m", None),
     "clip_tau": ("clip_tau", None),

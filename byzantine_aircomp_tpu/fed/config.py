@@ -41,6 +41,17 @@ class FedConfig:
     server_opt: str = "none"
     server_lr: float = 1.0
     server_momentum: float = 0.9
+    # client-side momentum (Karimireddy, He & Jaggi, ICML 2021 "Learning
+    # from History"): each client keeps m_i <- beta*m_i + (1-beta)*g_i
+    # across global iterations and sends w_global - gamma*m_i.  Momentum
+    # averages a client's gradients over ~1/(1-beta) rounds, which breaks
+    # time-coupled (inner-product-manipulation style) attacks that rely
+    # on small per-round biases, and is the form under which cclip's
+    # guarantees are proved.  0 = off (reference behavior).  Requires
+    # local_steps == 1 (the FedSGD regime the paper analyzes); adds a
+    # [K, d] state buffer carried across rounds (checkpointed, sharded
+    # over clients on meshes)
+    client_momentum: float = 0.0
 
     # dispatch
     agg: str = "gm"
@@ -257,6 +268,13 @@ class FedConfig:
         )
         assert self.fedprox_mu >= 0, (
             f"fedprox_mu must be >= 0, got {self.fedprox_mu}"
+        )
+        assert 0.0 <= self.client_momentum < 1.0, (
+            f"client_momentum must be in [0, 1), got {self.client_momentum}"
+        )
+        assert not (self.client_momentum and self.local_steps != 1), (
+            "client_momentum requires local_steps == 1 (the FedSGD regime "
+            "the momentum analysis covers); use server_opt for FedAvg"
         )
         assert self.prng_impl in ("threefry", "rbg", "unsafe_rbg"), (
             f"prng_impl must be 'threefry', 'rbg' or 'unsafe_rbg', "

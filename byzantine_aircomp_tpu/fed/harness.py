@@ -59,6 +59,8 @@ def run_title(cfg: FedConfig) -> str:
         title += f"_momentum{cfg.server_lr}m{cfg.server_momentum}"
     elif cfg.server_opt != "none":
         title += f"_{cfg.server_opt}{cfg.server_lr}"
+    if cfg.client_momentum:
+        title += f"_cm{cfg.client_momentum}"
     # result-affecting magnitude knobs (non-default only, same rationale)
     if cfg.attack_param is not None:
         title += f"_ap{cfg.attack_param}"
@@ -197,39 +199,50 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
     if cfg.checkpoint_dir:
         import jax
 
+        # everything beyond flat params that must survive a resume:
+        # server-optimizer state and the client-momentum buffer, as one
+        # pytree so the leaf-count match covers both
+        def _extra_state(t):
+            return (
+                getattr(t, "server_opt_state", ()),
+                getattr(t, "client_m", ()),
+            )
+
         checkpoint_fn = lambda r, t: checkpoint.save(
             cfg.checkpoint_dir,
             title,
             r,
             t.flat_params,
-            # custom OPTIMIZERS-registered trainers may have no server opt
-            jax.tree.leaves(getattr(t, "server_opt_state", ())),
+            jax.tree.leaves(_extra_state(t)),
         )
         if cfg.inherit:
             restored = checkpoint.load(cfg.checkpoint_dir, title)
             if restored is not None:
-                start_round, flat, opt_leaves = restored
+                start_round, flat, extra_leaves = restored
                 # restore through the trainer's existing layouts — a plain
                 # asarray would drop the mesh sharding on sharded runs
                 trainer.flat_params = jax.device_put(
                     flat, trainer.flat_params.sharding
                 )
-                own_state = getattr(trainer, "server_opt_state", ())
+                own_state = _extra_state(trainer)
                 own_leaves = jax.tree.leaves(own_state)
-                if len(opt_leaves) == len(own_leaves) and opt_leaves:
-                    trainer.server_opt_state = jax.tree.unflatten(
+                if len(extra_leaves) == len(own_leaves) and extra_leaves:
+                    server_state, client_m = jax.tree.unflatten(
                         jax.tree.structure(own_state),
                         [
                             jax.device_put(l, own.sharding)
-                            for l, own in zip(opt_leaves, own_leaves)
+                            for l, own in zip(extra_leaves, own_leaves)
                         ],
                     )
-                elif len(opt_leaves) != len(own_leaves):
+                    trainer.server_opt_state = server_state
+                    if not isinstance(client_m, tuple):  # () when disabled
+                        trainer.client_m = client_m
+                elif len(extra_leaves) != len(own_leaves):
                     log(
-                        "WARNING: checkpoint server-opt state "
-                        f"({len(opt_leaves)} leaves) does not match this "
-                        f"config ({len(own_leaves)}); starting the server "
-                        "optimizer fresh"
+                        "WARNING: checkpoint extra state "
+                        f"({len(extra_leaves)} leaves) does not match this "
+                        f"config ({len(own_leaves)}); starting server-opt/"
+                        "client-momentum state fresh"
                     )
                 log(f"Resumed from checkpoint at round {start_round}")
 

@@ -199,6 +199,16 @@ class FedTrainer:
             self._server_tx.init(self.flat_params) if self._server_tx else ()
         )
 
+        # per-client momentum buffer (Karimireddy 2021; cfg.client_momentum
+        # doc): [K, d] carried across global iterations.  () when off, so
+        # the default program's carry is cost-free.  The sharded trainer
+        # re-lays this out over the clients axis after the constructor
+        self.client_m = (
+            jnp.zeros((cfg.node_size, self.dim), jnp.float32)
+            if cfg.client_momentum
+            else ()
+        )
+
         # per-round key stream; model init above stays threefry so initial
         # params are identical whatever impl drives the round RNG.  Typed
         # keys (jax.random.key) carry their impl — a raw PRNGKey array of a
@@ -209,9 +219,11 @@ class FedTrainer:
         impl = "threefry2x32" if cfg.prng_impl == "threefry" else cfg.prng_impl
         self._base_key = jax.random.key(cfg.seed, impl=impl)
 
-        self._round_fn = jax.jit(self._build_round_fn(), donate_argnums=(0, 1))
+        self._round_fn = jax.jit(
+            self._build_round_fn(), donate_argnums=(0, 1, 2)
+        )
         self._multi_round_fn = jax.jit(
-            self._build_multi_round_fn(), donate_argnums=(0, 1)
+            self._build_multi_round_fn(), donate_argnums=(0, 1, 2)
         )
         self._eval_fn = jax.jit(self._build_eval_fn())
         self._eval_cache: Dict[str, Any] = {}
@@ -265,6 +277,22 @@ class FedTrainer:
         w_final, _ = jax.lax.scan(step, flat_params, (x_k, y_k))
         return w_final
 
+    def _per_client_momentum_step(self, flat_params, x_k, y_k, is_byz, m_prev):
+        """One momentum-SGD client step (cfg.client_momentum doc; requires
+        local_steps == 1 so x_k is [1, B, ...]): m <- beta*m + (1-beta)*g,
+        sent weights = w_global - gamma*m.  Returns (weights, new momentum).
+        Gradient-scale attacks poison g and therefore the momentum — the
+        attacked state is the client's own, as in the paper's threat model."""
+        cfg = self.cfg
+        gscale = 1.0
+        if self.attack is not None and self.attack.grad_scale != 1.0:
+            gscale = jnp.where(is_byz, self.attack.grad_scale, 1.0)
+        g = self._per_client_grad(flat_params, x_k[0], y_k[0], is_byz) * gscale
+        g = g + cfg.weight_decay * flat_params
+        beta = cfg.client_momentum
+        m_new = beta * m_prev + (1.0 - beta) * g
+        return flat_params - cfg.gamma * m_new, m_new
+
     def _iteration(self, carry, key, x_train, y_train, want_variance):
         """One global iteration: local steps -> attack -> channel -> agg.
 
@@ -279,7 +307,7 @@ class FedTrainer:
         ``display_interval - 1`` iterations skip the extra [honest, d]
         passes entirely."""
         cfg = self.cfg
-        flat_params, opt_state = carry
+        flat_params, opt_state, client_m = carry
         m_h, m_b = self._part_h, self._part_b
         # extra keys exist only on the programs that need them, so the
         # default configuration consumes the exact default RNG stream
@@ -329,9 +357,24 @@ class FedTrainer:
                 shape + (self._sample_shape if self._spatial_input else (-1,))
             )
             y = y_train[idx].reshape(shape)
-            w_stack = jax.vmap(self._per_client_weights, in_axes=(None, 0, 0, 0))(
-                flat_params, x, y, self._part_mask
-            )
+            if cfg.client_momentum:
+                m_prev = (
+                    client_m[part] if cfg.participation < 1.0 else client_m
+                )
+                w_stack, m_rows = jax.vmap(
+                    self._per_client_momentum_step,
+                    in_axes=(None, 0, 0, 0, 0),
+                )(flat_params, x, y, self._part_mask, m_prev)
+                client_m = (
+                    client_m.at[part].set(m_rows)
+                    if cfg.participation < 1.0
+                    else m_rows
+                )
+                client_m = self._constrain_stack(client_m)
+            else:
+                w_stack = jax.vmap(
+                    self._per_client_weights, in_axes=(None, 0, 0, 0)
+                )(flat_params, x, y, self._part_mask)
             w_stack = self._constrain_stack(w_stack)
 
         with jax.named_scope("message_attack"):
@@ -415,9 +458,11 @@ class FedTrainer:
             lambda w: jnp.float32(0.0),
             w_stack,
         )
-        return (new_flat, opt_state), variance
+        return (new_flat, opt_state, client_m), variance
 
-    def _round_core(self, flat_params, opt_state, round_key, x_train, y_train):
+    def _round_core(
+        self, flat_params, opt_state, client_m, round_key, x_train, y_train
+    ):
         """One round (display_interval scanned iterations) as a pure fn."""
         interval = self.cfg.display_interval
         keys = jax.random.split(round_key, interval)
@@ -427,10 +472,10 @@ class FedTrainer:
             key, want_var = kf
             return self._iteration(carry, key, x_train, y_train, want_var)
 
-        (final, opt_final), variances = jax.lax.scan(
-            it, (flat_params, opt_state), (keys, want)
+        (final, opt_final, m_final), variances = jax.lax.scan(
+            it, (flat_params, opt_state, client_m), (keys, want)
         )
-        return final, opt_final, variances[-1]
+        return final, opt_final, m_final, variances[-1]
 
     def _build_round_fn(self):
         return self._round_core
@@ -447,18 +492,19 @@ class FedTrainer:
         tests/test_training.py::test_run_rounds_matches_run_round_loop)."""
         base_key = self._base_key
 
-        def multi_fn(flat_params, opt_state, rounds, x_train, y_train):
+        def multi_fn(flat_params, opt_state, client_m, rounds, x_train, y_train):
             def body(carry, r):
-                fp, os = carry
-                fp, os, var = self._round_core(
-                    fp, os, jax.random.fold_in(base_key, r), x_train, y_train
+                fp, os, cm = carry
+                fp, os, cm, var = self._round_core(
+                    fp, os, cm, jax.random.fold_in(base_key, r),
+                    x_train, y_train,
                 )
-                return (fp, os), var
+                return (fp, os, cm), var
 
-            (final, opt_final), variances = jax.lax.scan(
-                body, (flat_params, opt_state), rounds
+            (final, opt_final, m_final), variances = jax.lax.scan(
+                body, (flat_params, opt_state, client_m), rounds
             )
-            return final, opt_final, variances
+            return final, opt_final, m_final, variances
 
         return multi_fn
 
@@ -519,9 +565,11 @@ class FedTrainer:
         (~3x the round's compute on a tunneled chip); callers convert when
         they actually consume the value."""
         round_key = jax.random.fold_in(self._base_key, round_idx)
-        self.flat_params, self.server_opt_state, variance = self._round_fn(
-            self.flat_params, self.server_opt_state, round_key,
-            self.x_train, self.y_train,
+        (
+            self.flat_params, self.server_opt_state, self.client_m, variance
+        ) = self._round_fn(
+            self.flat_params, self.server_opt_state, self.client_m,
+            round_key, self.x_train, self.y_train,
         )
         return variance
 
@@ -534,8 +582,10 @@ class FedTrainer:
         nothing (eval, logging, checkpointing) needs the params between
         rounds, e.g. benchmarking."""
         rounds = jnp.arange(start_round, start_round + num_rounds, dtype=jnp.int32)
-        self.flat_params, self.server_opt_state, variances = self._multi_round_fn(
-            self.flat_params, self.server_opt_state, rounds,
+        (
+            self.flat_params, self.server_opt_state, self.client_m, variances
+        ) = self._multi_round_fn(
+            self.flat_params, self.server_opt_state, self.client_m, rounds,
             self.x_train, self.y_train,
         )
         return variances

@@ -93,6 +93,12 @@ class ShardedFedTrainer(FedTrainer):
         self.x_train = jax.device_put(self.x_train, repl)
         self.y_train = jax.device_put(self.y_train, repl)
         self.flat_params = jax.device_put(self.flat_params, p_shard)
+        if cfg.client_momentum:
+            # the [K, d] momentum buffer follows the client-stack layout
+            self.client_m = jax.device_put(
+                self.client_m,
+                mesh_lib.sharding(self.mesh, mesh_lib.stack_spec()),
+            )
         # server-opt state: [d]-shaped leaves follow the params layout,
         # scalars (e.g. adam's count) replicate
         self.server_opt_state = jax.tree.map(

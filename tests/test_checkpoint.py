@@ -65,3 +65,38 @@ def test_resume_matches_uninterrupted_with_participation(tmp_path):
         lambda: _cfg(4, honest_size=8, agg="gm2", participation=0.5,
                      agg_maxiter=50),
     )
+
+
+def test_resume_matches_uninterrupted_with_client_momentum(tmp_path):
+    # the [K, d] momentum buffer is part of the resumable state: a resume
+    # that dropped it would diverge from the uninterrupted trajectory.
+    # Uses the harness checkpoint path (which persists the buffer).
+    import byzantine_aircomp_tpu.data.datasets as dl
+    from byzantine_aircomp_tpu.fed import harness
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+
+    def cfg(rounds):
+        return FedConfig(
+            honest_size=6, rounds=rounds, display_interval=3, batch_size=16,
+            agg="mean", eval_train=False, client_momentum=0.9,
+            checkpoint_dir=str(tmp_path) + "/", cache_dir=str(tmp_path) + "/c/",
+        )
+
+    orig = dl.load
+    dl.load = lambda name, **kw: orig(name, synthetic_train=1500, synthetic_val=300)
+    try:
+        full = harness.run(cfg(4), record_in_file=False)
+        # interrupted at 2 rounds, then resume to 4 via --inherit
+        harness.run(cfg(2), record_in_file=False)
+        resumed = harness.run(
+            FedConfig(**{**cfg(4).__dict__, "inherit": True}),
+            record_in_file=False,
+        )
+    finally:
+        dl.load = orig
+    # continuous loss, not 1/n-quantized accuracy: a dropped momentum
+    # buffer diverges the trajectory but can still land on the same
+    # correct-prediction count
+    np.testing.assert_allclose(
+        full["valLossPath"][-1], resumed["valLossPath"][-1], atol=1e-6
+    )

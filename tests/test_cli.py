@@ -177,6 +177,8 @@ def test_run_titles_distinct_across_extension_knobs():
         dict(agg="dnc", dnc_iters=5),
         dict(agg="dnc", dnc_sub_dim=500),
         dict(bucket_size=2),
+        dict(client_momentum=0.9),
+        dict(client_momentum=0.5),
     ]
     titles = [
         run_title(FedConfig(honest_size=8, **v)) for v in variants

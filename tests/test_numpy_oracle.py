@@ -144,3 +144,18 @@ def test_ref_backend_bucketing_runs_and_differs():
     bkt = run_ref(FedConfig(bucket_size=2, **kw), log_fn=quiet, dataset=ds)
     assert plain["valAccPath"] != bkt["valAccPath"]
     assert bkt["valAccPath"][-1] > 0.3, bkt["valAccPath"]
+
+
+def test_ref_backend_client_momentum_runs_and_learns():
+    from byzantine_aircomp_tpu.backends.ref_trainer import run_ref
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+
+    ds = data_lib.load("mnist", synthetic_train=1000, synthetic_val=200)
+    kw = dict(honest_size=8, rounds=3, display_interval=5, batch_size=8,
+              eval_train=False, agg="mean")
+    quiet = lambda s: None
+    plain = run_ref(FedConfig(**kw), log_fn=quiet, dataset=ds)
+    mom = run_ref(FedConfig(client_momentum=0.9, **kw), log_fn=quiet, dataset=ds)
+    assert plain["valAccPath"] != mom["valAccPath"]
+    assert mom["valAccPath"][-1] > 0.25, mom["valAccPath"]

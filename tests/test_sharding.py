@@ -330,3 +330,25 @@ def test_sharded_bucketing_matches_single_device():
         np.asarray(single.flat_params), np.asarray(sharded.flat_params),
         rtol=5e-4, atol=5e-6,
     )
+
+
+def test_sharded_client_momentum_matches_single_device():
+    # the [K, d] momentum buffer rides the scan carry sharded over
+    # 'clients'; trajectories must match the single-device path
+    ds = data_lib.load("mnist", synthetic_train=1600, synthetic_val=320)
+    kw = dict(
+        honest_size=13, byz_size=3, attack="classflip", rounds=2,
+        display_interval=3, batch_size=16, agg="gm2", eval_train=False,
+        agg_maxiter=50, client_momentum=0.9,
+    )
+    single = FedTrainer(FedConfig(**kw), dataset=ds)
+    sharded = ShardedFedTrainer(
+        FedConfig(**kw), dataset=ds, mesh=mesh_lib.make_mesh()
+    )
+    for r in range(2):
+        single.run_round(r)
+        sharded.run_round(r)
+    np.testing.assert_allclose(
+        np.asarray(single.flat_params), np.asarray(sharded.flat_params),
+        rtol=5e-4, atol=5e-6,
+    )

@@ -432,3 +432,42 @@ def test_bucketing_rejects_degenerate_krum_counts():
     with pytest.raises(AssertionError, match="krum"):
         make_cfg(honest_size=6, byz_size=2, attack="weightflip",
                  agg="krum", bucket_size=2).validate()
+
+
+def test_client_momentum_learns_and_differs():
+    # beta=0.9 EMA momentum ramps from zero (paper-faithful, no bias
+    # correction), so early rounds are slower than plain SGD by design —
+    # the horizon must cover the ~1/(1-beta)-iteration warmup
+    a = run_short(make_cfg(rounds=5, seed=13))
+    b = run_short(make_cfg(client_momentum=0.9, rounds=5, seed=13))
+    assert b["valAccPath"][-1] > 0.5, b["valAccPath"]
+    assert a["valAccPath"] != b["valAccPath"]
+
+
+def test_client_momentum_cclip_survives_weightflip():
+    # the paper's pairing: momentum + centered clipping under attack
+    paths = run_short(make_cfg(
+        agg="cclip", honest_size=9, byz_size=3, attack="weightflip",
+        client_momentum=0.9, rounds=5,
+    ))
+    assert paths["valAccPath"][-1] > 0.4, paths["valAccPath"]
+
+
+def test_client_momentum_validation():
+    with pytest.raises(AssertionError, match="local_steps"):
+        make_cfg(client_momentum=0.9, local_steps=4).validate()
+    with pytest.raises(AssertionError, match="client_momentum"):
+        make_cfg(client_momentum=1.0).validate()
+
+
+def test_client_momentum_composes_with_participation():
+    # under partial participation a client's momentum only advances on the
+    # iterations it is drawn, so the ramp is slower still — assert steady
+    # progress and finiteness rather than a fixed-round accuracy bar
+    paths = run_short(make_cfg(
+        agg="gm2", honest_size=9, byz_size=3, attack="classflip",
+        client_momentum=0.9, participation=2 / 3, rounds=5,
+    ))
+    assert np.isfinite(paths["valAccPath"]).all()
+    assert paths["valAccPath"][-1] > paths["valAccPath"][0] + 0.15, (
+        paths["valAccPath"])
