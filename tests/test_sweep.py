@@ -121,10 +121,51 @@ def test_sweep_partition_flag_reaches_cells():
     assert iid[("mean", None)]["val_acc"] != skew[("mean", None)]["val_acc"]
 
 
-def test_sweep_participation_flag_reaches_cells():
-    # regression: --participation was accepted by argparse but not
-    # forwarded into cfg_kw, silently benchmarking full participation
+def test_sweep_forwards_every_shared_knob():
+    # regression class: a knob accepted by argparse (via add_knob_flags)
+    # but not forwarded into cfg_kw silently benchmarks the default —
+    # --participation shipped with exactly this gap.  The knob set is
+    # derived from add_knob_flags ITSELF, so a future knob added there
+    # without a sample value (or without cfg_kw forwarding) fails loudly.
+    import argparse
+
     from byzantine_aircomp_tpu.analysis import sweep as sweep_mod
+    from byzantine_aircomp_tpu.cli import add_knob_flags
+
+    # one legal non-default sample per knob; keep values jointly valid for
+    # the K=8 B=0 mean cell below (bucketing divisibility etc.)
+    samples = {
+        "participation": 0.5,
+        "bucket_size": 2,
+        "client_momentum": 0.9,
+        "partition": "dirichlet",
+        "dirichlet_alpha": 0.7,
+        "attack_param": 2.5,
+        "krum_m": 2,
+        "clip_tau": 1.5,
+        "clip_iters": 5,
+        "sign_eta": 0.01,
+        "dnc_iters": 2,
+        "dnc_sub_dim": 64,
+        "dnc_c": 0.5,
+    }
+    probe = argparse.ArgumentParser()
+    add_knob_flags(probe)
+    flag_of = {
+        a.dest: a.option_strings[0]
+        for a in probe._actions
+        if a.dest != "help"
+    }
+    missing = set(flag_of) - set(samples)
+    assert not missing, (
+        f"new add_knob_flags knob(s) {sorted(missing)} need a sample value "
+        "here so their cfg_kw forwarding is covered"
+    )
+
+    argv = ["--aggs", "mean", "--attacks", "none", "--K", "8", "--B", "0",
+            "--rounds", "1", "--interval", "2", "--batch-size", "8"]
+    for dest, flag in flag_of.items():
+        argv += [flag, str(samples[dest])]
 
     captured = {}
     orig = sweep_mod.run_sweep
@@ -133,13 +174,70 @@ def test_sweep_participation_flag_reaches_cells():
         captured.update(cfg_kw)
         return orig(aggs, attacks, cfg_kw, **kw)
 
-    sweep_mod.run_sweep, orig_fn = spy, sweep_mod.run_sweep
+    sweep_mod.run_sweep = spy
     try:
-        sweep_mod.main([
-            "--aggs", "mean", "--attacks", "none", "--K", "8", "--B", "0",
-            "--rounds", "1", "--interval", "2", "--batch-size", "8",
-            "--participation", "0.5",
-        ])
+        sweep_mod.main(argv)
     finally:
-        sweep_mod.run_sweep = orig_fn
-    assert captured.get("participation") == 0.5
+        sweep_mod.run_sweep = orig
+    for dest in flag_of:
+        assert captured.get(dest) == samples[dest], (
+            dest, captured.get(dest))
+
+
+def test_sweep_partition_flag_reaches_cells():
+    # --partition dirichlet must change the cell's training data split
+    from byzantine_aircomp_tpu.analysis.sweep import run_sweep
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+
+    ds = data_lib.load("mnist", synthetic_train=800, synthetic_val=160)
+    kw = dict(
+        honest_size=8, byz_size=0, rounds=1, display_interval=2,
+        batch_size=8, eval_train=False,
+    )
+    iid = run_sweep(["mean"], [None], dict(kw), dataset=ds, log=lambda s: None)
+    skew = run_sweep(
+        ["mean"], [None],
+        dict(kw, partition="dirichlet", dirichlet_alpha=0.1),
+        dataset=ds, log=lambda s: None,
+    )
+    assert iid[("mean", None)]["val_acc"] != skew[("mean", None)]["val_acc"]
+
+
+def test_sweep_forwards_every_shared_knob():
+    # regression class: a knob accepted by argparse (via add_knob_flags)
+    # but not forwarded into cfg_kw silently benchmarks the default —
+    # --participation shipped with exactly this gap.  Pass every shared
+    # result-affecting knob at a non-default value and assert each one
+    # reaches the config dict.
+    from byzantine_aircomp_tpu.analysis import sweep as sweep_mod
+
+    knobs = {
+        "participation": ("--participation", "0.5", 0.5),
+        "bucket_size": ("--bucket-size", "2", 2),
+        "client_momentum": ("--client-momentum", "0.9", 0.9),
+        "partition": ("--partition", "dirichlet", "dirichlet"),
+        "dirichlet_alpha": ("--dirichlet-alpha", "0.7", 0.7),
+        "clip_iters": ("--clip-iters", "5", 5),
+        "dnc_iters": ("--dnc-iters", "2", 2),
+        "dnc_sub_dim": ("--dnc-sub-dim", "64", 64),
+        "dnc_c": ("--dnc-c", "0.5", 0.5),
+    }
+    argv = ["--aggs", "mean", "--attacks", "none", "--K", "8", "--B", "0",
+            "--rounds", "1", "--interval", "2", "--batch-size", "8"]
+    for flag, value, _ in knobs.values():
+        argv += [flag, value]
+
+    captured = {}
+    orig = sweep_mod.run_sweep
+
+    def spy(aggs, attacks, cfg_kw, **kw):
+        captured.update(cfg_kw)
+        return orig(aggs, attacks, cfg_kw, **kw)
+
+    sweep_mod.run_sweep = spy
+    try:
+        sweep_mod.main(argv)
+    finally:
+        sweep_mod.run_sweep = orig
+    for field, (_, _, want) in knobs.items():
+        assert captured.get(field) == want, (field, captured.get(field))
