@@ -36,7 +36,17 @@ def trimmed_mean(w: np.ndarray, trim_ratio: float = 0.1) -> np.ndarray:
 
 
 def _krum_scores(w: np.ndarray, honest_size: int) -> np.ndarray:
-    dist = ((w[:, None, :] - w[None, :, :]) ** 2).sum(axis=-1)
+    # Mask non-finite rows BEFORE the broadcast: Inf - Inf would emit a
+    # RuntimeWarning and produce NaN distances.  Matching the JAX path
+    # (ops.aggregators.pairwise_sq_dists), any distance involving a
+    # non-finite row is +Inf (never selected) and the diagonal is 0.
+    finite = np.isfinite(w).all(axis=1)
+    wz = np.where(finite[:, None], w, 0.0)
+    dist = ((wz[:, None, :] - wz[None, :, :]) ** 2).sum(axis=-1)
+    bad = ~finite
+    dist[bad, :] = np.inf
+    dist[:, bad] = np.inf
+    np.fill_diagonal(dist, 0.0)
     k_sel = honest_size - 2 + 1
     return np.sort(dist, axis=1)[:, :k_sel].sum(axis=1)
 

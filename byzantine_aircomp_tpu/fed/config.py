@@ -126,8 +126,9 @@ class FedConfig:
     dirichlet_alpha: float = 0.3
     # partial participation (the FedAvg setting; the reference activates
     # every client every iteration): each global iteration runs a
-    # STRATIFIED sample of round(participation * honest_size) honest and
-    # round(participation * byz_size) Byzantine clients, drawn fresh per
+    # STRATIFIED sample of half-up(participation * honest_size) honest and
+    # floor(participation * byz_size) Byzantine clients (see
+    # participant_counts for the rounding policy), drawn fresh per
     # iteration.  Stratification keeps the Byzantine fraction (and so the
     # aggregators' honest_size contract) exact with static shapes; 1.0
     # (default) is bit-identical to the full-participation program
@@ -145,12 +146,24 @@ class FedConfig:
 
     def participant_counts(self) -> tuple:
         """(honest, Byzantine) rows per iteration — the single source of
-        the round(f*H)/round(f*B) stratified-draw policy (trainer, sharded
-        divisibility check, oracle backend, and validation all use it)."""
+        the stratified-draw policy (trainer, sharded divisibility check,
+        oracle backend, and validation all use it).
+
+        Rounding policy: honest count rounds half-up; Byzantine count is
+        FLOORED.  Python's round() is banker's rounding, which can round an
+        exact .5 tie down for honest and up for Byzantine (H=13, B=3,
+        f=0.5 -> 6 honest + 2 byz: 25% Byzantine among participants vs
+        18.75% in the population).  Flooring f*B means rounding never
+        inflates the number of participating attackers beyond f*B; the
+        residual fraction shift from honest-side rounding at tiny counts
+        is bounded by one client."""
         if self.participation < 1.0:
+            # the epsilon guards the floor against binary-float products
+            # landing just under an exact integer (0.29 * 100 ->
+            # 28.999999999999996: mathematical floor is 29, not 28)
             return (
-                round(self.participation * self.honest_size),
-                round(self.participation * self.byz_size),
+                int(self.participation * self.honest_size + 0.5),
+                int(self.participation * self.byz_size + 1e-9),
             )
         return self.honest_size, self.byz_size
 

@@ -513,7 +513,9 @@ def bulyan_tail(sel: jnp.ndarray, beta: int) -> jnp.ndarray:
     dist_t = jnp.abs(sel - med[None, :]).T  # [d, theta]
     _, cols = jax.lax.top_k(-dist_t, beta)  # beta closest to median per coord
     vals = jnp.take_along_axis(sel.T, cols, axis=1)  # [d, beta]
-    return jnp.mean(vals, axis=1)
+    # f32 accumulation even under --stack-dtype bf16 (the stack_dtype
+    # contract: storage may be bf16, arithmetic stays f32)
+    return jnp.mean(vals.astype(jnp.float32), axis=1)
 
 
 def _weiszfeld_dists(wmatrix, guess):

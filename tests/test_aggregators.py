@@ -434,7 +434,7 @@ def test_gm2_and_cclip_exclude_nonfinite_rows_like_oracle():
 # bf16 stack input (--stack-dtype bf16): f32 arithmetic, f32-quality output
 
 
-@pytest.mark.parametrize("name", ["gm2", "mean", "cclip", "krum"])
+@pytest.mark.parametrize("name", ["gm2", "mean", "cclip", "krum", "bulyan"])
 def test_aggregators_accept_bf16_stack(wmat, name):
     # the trainer may hand the aggregator a bf16 view of the [K, d] stack;
     # every aggregator must produce a finite result close to its f32 answer

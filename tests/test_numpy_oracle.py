@@ -159,3 +159,32 @@ def test_ref_backend_client_momentum_runs_and_learns():
     mom = run_ref(FedConfig(client_momentum=0.9, **kw), log_fn=quiet, dataset=ds)
     assert plain["valAccPath"] != mom["valAccPath"]
     assert mom["valAccPath"][-1] > 0.25, mom["valAccPath"]
+
+
+def test_oracle_krum_inf_rows_warning_free_and_never_selected():
+    # Inf - Inf in the [K, K, d] broadcast used to emit a RuntimeWarning
+    # (NaN distances).  The oracle must stay silent (pyproject turns
+    # backends/ RuntimeWarnings into errors) and mirror the JAX hardening:
+    # a non-finite row scores +Inf and can never win the selection.
+    import warnings
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(8, 16)).astype(np.float32)
+    w[2] = np.inf
+    w[5, 3] = np.nan
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        scores = numpy_ref._krum_scores(w, honest_size=6)
+        sel = numpy_ref.krum(w, honest_size=6)
+        mk = numpy_ref.multi_krum(w, honest_size=6)
+    assert np.isinf(scores[2]) and np.isinf(scores[5])
+    assert np.isfinite(sel).all()
+    assert np.isfinite(mk).all()
+
+    # selection agrees with the JAX path on the same poisoned stack
+    import jax.numpy as jnp
+
+    from byzantine_aircomp_tpu.ops import aggregators as agg
+
+    jsel = np.asarray(agg.krum(jnp.asarray(w), honest_size=6))
+    np.testing.assert_array_equal(sel, jsel)
