@@ -219,14 +219,24 @@ class FedTrainer:
         impl = "threefry2x32" if cfg.prng_impl == "threefry" else cfg.prng_impl
         self._base_key = jax.random.key(cfg.seed, impl=impl)
 
+        copts = self._jit_compiler_options()
         self._round_fn = jax.jit(
-            self._build_round_fn(), donate_argnums=(0, 1, 2)
+            self._build_round_fn(), donate_argnums=(0, 1, 2),
+            compiler_options=copts,
         )
         self._multi_round_fn = jax.jit(
-            self._build_multi_round_fn(), donate_argnums=(0, 1, 2)
+            self._build_multi_round_fn(), donate_argnums=(0, 1, 2),
+            compiler_options=copts,
         )
-        self._eval_fn = jax.jit(self._build_eval_fn())
+        self._eval_fn = jax.jit(self._build_eval_fn(), compiler_options=copts)
         self._eval_cache: Dict[str, Any] = {}
+
+    def _jit_compiler_options(self):
+        """Per-executable XLA option overrides; None on the single-device
+        path.  ``ShardedFedTrainer`` relaxes the CPU collective rendezvous
+        timeouts here (the XLA_FLAGS parser in this jaxlib build does not
+        register those debug options, so they must ride CompileOptions)."""
+        return None
 
     # sharding hooks — identity on a single device; the parallel layer
     # (``..parallel.sharded``) overrides these with with_sharding_constraint
@@ -292,6 +302,23 @@ class FedTrainer:
         beta = cfg.client_momentum
         m_new = beta * m_prev + (1.0 - beta) * g
         return flat_params - cfg.gamma * m_new, m_new
+
+    def _client_stack(self, flat_params, x, y, part_mask):
+        """[m, d] sent-weight stack from the per-client local steps — the
+        client-parallel seam.  vmap over clients; ``ShardedFedTrainer``
+        overrides this with an explicit shard_map over the 'clients' mesh
+        axis (GSPMD left alone can repartition a vmapped CONV to
+        channel-parallel, all-gathering the client batch every local step)."""
+        return jax.vmap(
+            self._per_client_weights, in_axes=(None, 0, 0, 0)
+        )(flat_params, x, y, part_mask)
+
+    def _client_stack_momentum(self, flat_params, x, y, part_mask, m_prev):
+        """Momentum variant of ``_client_stack``: returns (stack, new [m, d]
+        momentum rows)."""
+        return jax.vmap(
+            self._per_client_momentum_step, in_axes=(None, 0, 0, 0, 0)
+        )(flat_params, x, y, part_mask, m_prev)
 
     def _iteration(self, carry, key, x_train, y_train, want_variance):
         """One global iteration: local steps -> attack -> channel -> agg.
@@ -361,10 +388,9 @@ class FedTrainer:
                 m_prev = (
                     client_m[part] if cfg.participation < 1.0 else client_m
                 )
-                w_stack, m_rows = jax.vmap(
-                    self._per_client_momentum_step,
-                    in_axes=(None, 0, 0, 0, 0),
-                )(flat_params, x, y, self._part_mask, m_prev)
+                w_stack, m_rows = self._client_stack_momentum(
+                    flat_params, x, y, self._part_mask, m_prev
+                )
                 client_m = (
                     client_m.at[part].set(m_rows)
                     if cfg.participation < 1.0
@@ -372,9 +398,9 @@ class FedTrainer:
                 )
                 client_m = self._constrain_stack(client_m)
             else:
-                w_stack = jax.vmap(
-                    self._per_client_weights, in_axes=(None, 0, 0, 0)
-                )(flat_params, x, y, self._part_mask)
+                w_stack = self._client_stack(
+                    flat_params, x, y, self._part_mask
+                )
             w_stack = self._constrain_stack(w_stack)
 
         with jax.named_scope("message_attack"):

@@ -108,6 +108,88 @@ class ShardedFedTrainer(FedTrainer):
             self.server_opt_state,
         )
 
+    def _jit_compiler_options(self):
+        """On the multi-device CPU mesh (CI / dryrun), device "threads"
+        oversubscribe the host core(s): during a heavy sharded program the
+        participants of a collective can reach the rendezvous more than
+        XLA's default 40s apart, and rendezvous.cc then ABORTS the whole
+        process ("Termination timeout ... exceeded").  Arrival skew on an
+        oversubscribed host is not a hang — give the rendezvous room.
+        Real accelerator backends keep their defaults."""
+        if jax.default_backend() != "cpu" or self.mesh.size <= 1:
+            return None
+        return {
+            "xla_cpu_collective_call_warn_stuck_seconds": 300,
+            "xla_cpu_collective_call_terminate_timeout_seconds": 1200,
+        }
+
+    # the two vma (varying-manual-axes) moves every shard_mapped client
+    # step needs:
+    #
+    # * ``pcast(fp, to='varying')`` BEFORE differentiating — jax.grad
+    #   w.r.t. an INVARYING (replicated, in_spec P()) shard_map input
+    #   auto-psums the cotangent across devices "for" the caller, which
+    #   here would silently turn every client's gradient into the
+    #   cross-device SUM of gradients (caught by the equality gates: the
+    #   stack degenerated to one device's rows tiled mesh-wide);
+    # * ``psum(out, 'model') / axis_size`` AFTER — the client step is
+    #   replicated over the model axis (each model-group device holds the
+    #   same clients), and averaging the bit-identical copies (exact for
+    #   power-of-two axis sizes) demotes the result back to INVARYING over
+    #   'model' so ``out_specs=P('clients')`` typechecks; there is no
+    #   free varying->invarying cast in jax's vma system.
+    def _shard_mapped_client_step(self, per_client_fn, n_outputs, *client_args):
+        """Run a vmapped per-client function under an EXPLICIT shard_map
+        over 'clients', with the replicated flat params as first operand.
+
+        Left to GSPMD, a vmapped conv's cost model can repartition the
+        per-client forward/backward to CHANNEL-parallel — all-gathering the
+        client-sharded [m*B, H, W, C] batch and every conv activation on
+        every local step (observed on the 8-device CPU mesh, where the
+        resulting in-process AllGather can also blow XLA's collective
+        rendezvous timeout and abort the process).  shard_map pins the
+        intended layout: each device runs its own clients' full local step
+        (params replicated FSDP-style — one [d] all-gather over 'model' at
+        entry when model_parallel > 1), and every [m, ...] output comes out
+        client-sharded; the aggregation stages then reshard d over 'model'
+        via the existing constraint.
+
+        ``client_args[0]`` is flat_params (in_spec P(), replicated); the
+        rest are [m, ...] arrays (in_spec P('clients'))."""
+        from jax.sharding import PartitionSpec as P
+
+        axes = (mesh_lib.CLIENT_AXIS, mesh_lib.MODEL_AXIS)
+        in_axes = (None,) + (0,) * (len(client_args) - 1)
+
+        def local(fp, *rest):
+            fp = jax.lax.pcast(fp, axes, to="varying")
+            out = jax.vmap(per_client_fn, in_axes=in_axes)(fp, *rest)
+            return jax.tree.map(
+                lambda g: jax.lax.psum(g, mesh_lib.MODEL_AXIS)
+                / jax.lax.axis_size(mesh_lib.MODEL_AXIS),
+                out,
+            )
+
+        out_spec = P(mesh_lib.CLIENT_AXIS)
+        return jax.shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(P(),) + (P(mesh_lib.CLIENT_AXIS),)
+            * (len(client_args) - 1),
+            out_specs=out_spec if n_outputs == 1 else (out_spec,) * n_outputs,
+        )(*client_args)
+
+    def _client_stack(self, flat_params, x, y, part_mask):
+        return self._shard_mapped_client_step(
+            self._per_client_weights, 1, flat_params, x, y, part_mask
+        )
+
+    def _client_stack_momentum(self, flat_params, x, y, part_mask, m_prev):
+        return self._shard_mapped_client_step(
+            self._per_client_momentum_step, 2,
+            flat_params, x, y, part_mask, m_prev,
+        )
+
     def _constrain_stack(self, w_stack):
         return jax.lax.with_sharding_constraint(
             w_stack, mesh_lib.sharding(self.mesh, mesh_lib.stack_spec())

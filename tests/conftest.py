@@ -35,6 +35,15 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running integration tests (multi-process, presets)"
     )
+    config.addinivalue_line(
+        "markers",
+        "heavy: multi-hour gates (the noisy-channel full-schedule parity "
+        "run is ~2.5h: the oracle's AirComp GM runs hundreds of NumPy "
+        "Weiszfeld steps per aggregation x 1000 aggregations x 2 backends "
+        "x 2 seeds); excluded from --runslow, opt in with --runheavy "
+        "(RUN_HEAVY=1).  Measured results are recorded in docs/ so the "
+        "evidence survives between opt-in runs.",
+    )
 
 
 def pytest_addoption(parser):
@@ -44,20 +53,38 @@ def pytest_addoption(parser):
         default=False,
         help="also run slow-marked tests (the full tier)",
     )
+    parser.addoption(
+        "--runheavy",
+        action="store_true",
+        default=False,
+        help="also run heavy-marked tests (multi-hour full-schedule gates)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
-    """Two test tiers (judge r2 item 4: the full suite's ~22 min is an
+    """Three test tiers (judge r2 item 4: the full suite's ~22 min is an
     iteration-speed tax).  Default = quick tier; the full tier runs with
-    ``pytest tests/ --runslow`` (or ``RUN_SLOW=1``) and before snapshots.
-    Every slow-marked family keeps at least one quick representative."""
-    if config.getoption("--runslow") or os.environ.get("RUN_SLOW", "") not in ("", "0"):
-        return
+    ``pytest tests/ --runslow`` (or ``RUN_SLOW=1``) and before snapshots;
+    ``--runheavy`` additionally admits the multi-hour gates."""
     import pytest
 
-    skip = pytest.mark.skip(
+    run_heavy = config.getoption("--runheavy") or os.environ.get(
+        "RUN_HEAVY", ""
+    ) not in ("", "0")
+    # heavy implies slow: --runheavy means "everything, including the
+    # multi-hour gates" (the docs' "additionally admits")
+    run_slow = run_heavy or config.getoption("--runslow") or os.environ.get(
+        "RUN_SLOW", ""
+    ) not in ("", "0")
+    skip_slow = pytest.mark.skip(
         reason="slow tier: pass --runslow (or RUN_SLOW=1) to include"
     )
+    skip_heavy = pytest.mark.skip(
+        reason="heavy tier: pass --runheavy (or RUN_HEAVY=1) to include"
+    )
     for item in items:
-        if "slow" in item.keywords:
-            item.add_marker(skip)
+        if "heavy" in item.keywords:
+            if not run_heavy:
+                item.add_marker(skip_heavy)
+        elif "slow" in item.keywords and not run_slow:
+            item.add_marker(skip_slow)

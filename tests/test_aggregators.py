@@ -633,3 +633,33 @@ def test_dnc_inf_row_does_not_shield_finite_outliers():
         w, len(w) - b, np.random.default_rng(4), dnc_c=1.0 / 3.0
     )
     assert np.linalg.norm(want - honest_mean) < 0.6 * gap
+
+
+@pytest.mark.slow
+def test_bulyan_blocked_at_real_large_d_matches_dense_selection():
+    # the [theta, d] selection audit at a shape that engages the blocked
+    # path under the REAL _DENSE_MAX_ELEMS budget (theta*d = 64M elems >
+    # 1<<25), not a shrunken one: the ResNet-scale regime where the
+    # gather-per-column-block must select the same theta rows and
+    # tail-average them identically to a one-shot dense [theta, d] gather
+    # on the SAME scores.  (Cross-backend equality is gated at shrunken
+    # budget above — at d=4M the honest rows are near-equidistant, so f32
+    # Gram scores vs f64 NumPy scores legitimately order the selection
+    # boundary differently; within-JAX the scores are shared and the
+    # comparison is exact.)
+    rng = np.random.default_rng(13)
+    k, d, honest = 20, 1 << 22, 18
+    w = 0.1 * rng.standard_normal((k, d)).astype(np.float32)
+    w[honest:] += 5.0  # B=2 planted outliers
+    theta, beta = agg.bulyan_sizes(k, k - honest)
+    assert theta * d > agg._DENSE_MAX_ELEMS  # real-budget blocked regime
+    wj = jnp.asarray(w)
+    got = np.asarray(agg.bulyan(wj, honest_size=honest))
+    assert got.shape == (d,) and np.isfinite(got).all()
+
+    scores = agg.krum_scores(wj, honest)
+    _, idx = jax.lax.top_k(-scores, theta)
+    # the planted outliers must be excluded from the selection at this d
+    assert not set(np.asarray(idx).tolist()) & {honest, honest + 1}
+    want = np.asarray(agg.bulyan_tail(wj[idx], beta))
+    np.testing.assert_allclose(got, want, rtol=0, atol=0)

@@ -273,3 +273,48 @@ def test_cnn_ref_backend_end_to_end():
     assert abs(a - b) < 0.25, (
         f"jax={jax_paths['valAccPath']} ref={ref_paths['valAccPath']}"
     )
+
+
+@pytest.mark.heavy
+def test_full_schedule_parity_aircomp():
+    """Third full-schedule north-star config: the paper's HEADLINE AirComp
+    mode — ``gm`` with OMA2 noise (--var 1e-2) inside every Weiszfeld step,
+    classflip B=10 (the reference's third README config,
+    ``MNIST_Air_weight.py:131-160``, ``README.md:27-31``) — on
+    ``mnist_hard``, same two-seed / seed-mean <= 0.005 structure as the
+    ideal-channel gates above.
+
+    This is the gate that pins the AirComp penalty in docs/RESULTS.md as
+    physics rather than backend drift: both backends run the same noisy
+    channel and must land within 0.5% of EACH OTHER even though both sit
+    ~5 points below the ideal-channel gm2 cell.
+
+    Measured 2026-07-31 (docs/aircomp_parity_r04.json): per-seed delta
+    +0.0095 (2021) / -0.0041 (2022) — opposite signs — seed-mean +0.0027,
+    inside the 0.5% gate; all four runs in the 0.839-0.861 band.
+
+    Heavy tier (--runheavy), not slow: the reference caller runs the noisy
+    Weiszfeld up to 1000 steps per aggregation (``:350``) and noise keeps
+    the early-exit from firing while clients are dispersed, so the
+    oracle's 1000 aggregations put ONE backend run at ~60-90 min on the
+    CPU CI host (~2.5h for the full two-seed gate; deterministic given the
+    seeds, so a pass is reproducible).
+    """
+    ds = data_lib.load("mnist_hard", synthetic_train=20000, synthetic_val=10000)
+    per_seed = []
+    for seed in (2021, 2022):
+        a, b = _run_full_schedule(
+            ds, seed,
+            honest_size=40, byz_size=10, attack="classflip", agg="gm",
+            noise_var=1e-2,
+        )
+        # classflip B=10 through the noisy channel converges below the
+        # ceiling but must still clearly learn on both backends
+        assert a > 0.8 and b > 0.8, (seed, a, b)
+        assert abs(a - b) <= 0.01, (seed, a, b)
+        per_seed.append((a, b))
+    jax_mean = float(np.mean([a for a, _ in per_seed]))
+    ref_mean = float(np.mean([b for _, b in per_seed]))
+    assert abs(jax_mean - ref_mean) <= 0.005, (
+        f"jax={jax_mean:.4f} ref={ref_mean:.4f} per-seed={per_seed}"
+    )
