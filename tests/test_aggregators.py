@@ -649,7 +649,9 @@ def test_bulyan_blocked_at_real_large_d_matches_dense_selection():
     # comparison is exact.)
     rng = np.random.default_rng(13)
     k, d, honest = 20, 1 << 22, 18
-    w = 0.1 * rng.standard_normal((k, d)).astype(np.float32)
+    # f32 generation: the f64 default would put a ~1.6 GB transient on the
+    # CI host for a 320 MB test stack
+    w = 0.1 * rng.standard_normal((k, d), dtype=np.float32)
     w[honest:] += 5.0  # B=2 planted outliers
     theta, beta = agg.bulyan_sizes(k, k - honest)
     assert theta * d > agg._DENSE_MAX_ELEMS  # real-budget blocked regime
