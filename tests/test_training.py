@@ -503,5 +503,6 @@ def test_client_momentum_beats_plain_sgd_under_ipm_skew():
     ).train()
     a = float(np.mean(plain["valAccPath"][-5:]))
     b = float(np.mean(mom["valAccPath"][-5:]))
-    # measured 0.6526 vs 0.7899 (+0.137); gate at half the measured gap
+    # measured 0.6526 vs 0.7899 (+0.137); gate at ~1/3 of the measured gap
+    # to leave headroom for seed-independent numeric drift
     assert b > a + 0.05, (a, b)
