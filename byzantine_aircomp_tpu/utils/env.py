@@ -20,6 +20,10 @@ def scrubbed_cpu_env(n_devices: int | None = None) -> dict:
     # tunnel relay is wedged) on this variable — unset disables axon boot
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    # persistent compilation cache: the dryrun's CNN stage and bench's CPU
+    # fallback each cost minutes of XLA compile on the 1-core host; cache
+    # them (jax defaults: only compiles >1s are stored)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", default_cache_dir())
     if n_devices is not None:
         parts = [
             f
@@ -29,6 +33,18 @@ def scrubbed_cpu_env(n_devices: int | None = None) -> dict:
         parts.append(f"--xla_force_host_platform_device_count={n_devices}")
         env["XLA_FLAGS"] = " ".join(parts)
     return env
+
+
+def default_cache_dir() -> str:
+    """Repo-local persistent XLA compilation cache dir (gitignored) — the
+    single derivation shared by conftest and the subprocess env, so the
+    in-process and spawned-process caches cannot silently split."""
+    return os.path.join(
+        os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        ".jax_cache",
+    )
 
 
 def diagnose_relay(ports=(8082, 8083), timeout: float = 3.0) -> str:

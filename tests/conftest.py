@@ -26,6 +26,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+# persistent compilation cache: the sharded CNN/ResNet equality gates cost
+# minutes of XLA compile each on this 1-core host; caching (default
+# thresholds: compiles >1s) makes suite re-runs and the heavy-tier gates
+# dramatically cheaper.  A user-set JAX_COMPILATION_CACHE_DIR wins in BOTH
+# the in-process config and spawned subprocesses (sweep CLI, multihost
+# workers inherit os.environ), so the cache never silently splits; the
+# default is the repo-local gitignored dir shared with
+# utils/env.py::scrubbed_cpu_env.
+from byzantine_aircomp_tpu.utils.env import default_cache_dir  # noqa: E402
+
+_cache_dir = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", default_cache_dir()
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
 
 assert jax.default_backend() == "cpu"
 assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for mesh tests"

@@ -423,3 +423,31 @@ def test_sharded_resnet_trainer_matches_single_device():
         np.asarray(single.flat_params), np.asarray(sharded.flat_params),
         rtol=5e-4, atol=5e-6,
     )
+
+
+def test_client_stack_shard_map_equals_vmap_gradients():
+    # function-level pin on the vma trap the trainer-level gates catch
+    # indirectly: jax.grad w.r.t. a REPLICATED shard_map input silently
+    # psums the cotangent across devices unless the params are pcast to
+    # varying first (sharded.py::_shard_mapped_client_step).  Regression:
+    # without the pcast, every client's "gradient" here becomes the
+    # cross-device sum and the stacks differ by O(step size).
+    ds = data_lib.load("mnist", synthetic_train=800, synthetic_val=160)
+    kw = dict(honest_size=13, byz_size=3, attack="classflip", rounds=1,
+              display_interval=2, batch_size=8, agg="mean", eval_train=False)
+    single = FedTrainer(FedConfig(**kw), dataset=ds)
+    for model_parallel in (1, 2):
+        sharded = ShardedFedTrainer(
+            FedConfig(**kw), dataset=ds,
+            mesh=mesh_lib.make_mesh(model_parallel=model_parallel),
+        )
+        fp = jnp.asarray(np.asarray(single.flat_params))
+        rng = np.random.default_rng(0)
+        m, E, B = 16, 1, 8
+        x = jnp.asarray(rng.standard_normal((m, E, B, 784)).astype(np.float32))
+        y = jnp.asarray(rng.integers(0, 10, (m, E, B)))
+        a = np.asarray(single._client_stack(fp, x, y, single._part_mask))
+        b = np.asarray(sharded._client_stack(fp, x, y, single._part_mask))
+        # bitwise at mp=1 (identical per-client programs); the mp=2
+        # psum-average of bit-identical replicas is exact too
+        np.testing.assert_array_equal(a, b)
