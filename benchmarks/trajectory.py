@@ -1,0 +1,94 @@
+"""Per-round accuracy trajectory runner for convergence evidence.
+
+The robustness matrices (`analysis/sweep.py`) record FINAL accuracy per
+cell; the BASELINE scale-up configs additionally need the trajectory —
+does a (model, attack, aggregator) cell converge, to what plateau, and in
+what order vs its competitors (reference deliverable: the accuracy curves
+of `/root/reference/draw.ipynb` cell 1).  This runner trains one cell and
+emits one JSON line per round (round, val_loss, val_acc, cumulative
+seconds) so plateaus can be judged from the file and tail-window means
+assembled for docs/RESULTS.md.
+
+Usage (CPU-scaled EMNIST rung, docs/RESULTS.md):
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      python benchmarks/trajectory.py --out /tmp/emnist_gm2.jsonl \
+        --set dataset=emnist model=CNN fc_width=128 honest_size=16 \
+              byz_size=4 batch_size=8 attack=classflip agg=gm2 rounds=60 \
+              eval_train=False
+
+Any `FedConfig` field can be set via ``--set key=value``; values are
+coerced by the dataclass field type (bool accepts True/False, Optional
+fields accept "none").
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+import typing
+
+from byzantine_aircomp_tpu.fed.config import FedConfig
+from byzantine_aircomp_tpu.fed.train import FedTrainer
+
+_FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(FedConfig)}
+
+
+def _coerce(name: str, raw: str):
+    """Coerce a key=value string by the FedConfig field's annotation."""
+    if name not in _FIELD_TYPES:
+        raise SystemExit(f"unknown FedConfig field {name!r}")
+    tp = _FIELD_TYPES[name]
+    if isinstance(tp, str):  # from __future__ annotations
+        tp = eval(tp, vars(typing), {"Optional": typing.Optional})  # noqa: S307
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:  # Optional[...]
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if raw.lower() in ("none", "null"):
+            return None
+        tp = args[0]
+    if tp is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return tp(raw)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", required=True, help="JSONL output path")
+    p.add_argument(
+        "--set", nargs="+", default=[], metavar="KEY=VALUE",
+        help="FedConfig overrides",
+    )
+    args = p.parse_args(argv)
+
+    kw = {}
+    for item in args.set:
+        k, _, v = item.partition("=")
+        kw[k] = _coerce(k, v)
+    cfg = FedConfig(**kw)
+    trainer = FedTrainer(cfg)
+
+    t0 = time.perf_counter()
+    with open(args.out, "w") as fh:
+        fh.write(json.dumps({"config": kw}) + "\n")
+        fh.flush()
+        for r in range(cfg.rounds):
+            trainer.run_round(r)
+            loss, acc = trainer.evaluate("val")
+            row = {
+                "round": r,
+                "val_loss": round(float(loss), 4),
+                "val_acc": round(float(acc), 4),
+                "secs": round(time.perf_counter() - t0, 1),
+            }
+            fh.write(json.dumps(row) + "\n")
+            fh.flush()
+            print(row, file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

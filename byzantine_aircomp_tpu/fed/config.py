@@ -158,11 +158,12 @@ class FedConfig:
         residual fraction shift from honest-side rounding at tiny counts
         is bounded by one client."""
         if self.participation < 1.0:
-            # the epsilon guards the floor against binary-float products
-            # landing just under an exact integer (0.29 * 100 ->
-            # 28.999999999999996: mathematical floor is 29, not 28)
+            # the epsilon guards both roundings against binary-float
+            # products landing just under a mathematical integer or .5 tie
+            # (0.29 * 100 -> 28.999999999999996: mathematical floor is 29,
+            # not 28; same failure class for the honest half-up threshold)
             return (
-                int(self.participation * self.honest_size + 0.5),
+                int(self.participation * self.honest_size + 0.5 + 1e-9),
                 int(self.participation * self.byz_size + 1e-9),
             )
         return self.honest_size, self.byz_size

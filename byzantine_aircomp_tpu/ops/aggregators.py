@@ -117,8 +117,10 @@ def pairwise_sq_dists(wmatrix: jnp.ndarray) -> jnp.ndarray:
     instead of the reference's [K, K, d] broadcast (``:199``).  Clamped at 0
     against float cancellation.  Non-finite rows (e.g. an overflowed gaussian
     attack) produce Inf - Inf = NaN in the Gram form; those distances are
-    mapped to +Inf and the diagonal is forced to its exact value 0, so a
-    poisoned row scores Inf instead of NaN and can never win the selection.
+    mapped to +Inf.  The diagonal is the exact value 0 for well-formed rows
+    and +Inf for poisoned ones (non-finite entries OR an f32-overflowing
+    squared norm — both make ``sq`` non-finite), so a poisoned row scores
+    Inf for ANY k_sel and can never win the selection.
     """
     # sq must match the Gram term's f32 accumulation: with a bf16 stack, a
     # bf16 sq would put ~0.4% relative error on ||w||^2 while gram is f32 —
@@ -136,7 +138,15 @@ def pairwise_sq_dists(wmatrix: jnp.ndarray) -> jnp.ndarray:
     dist = jnp.where(jnp.isnan(dist), jnp.inf, dist)
     dist = jnp.maximum(dist, 0.0)
     k = wmatrix.shape[0]
-    return jnp.where(jnp.eye(k, dtype=bool), 0.0, dist)
+    # diagonal: exact 0 for well-formed rows, +Inf for poisoned ones.  A 0
+    # diagonal on a poisoned row would let it win selection in the
+    # degenerate k_sel=1 case (honest_size=2): its sorted row is
+    # [0, Inf, ...] and its score 0.  The poisoned test is sq's finiteness,
+    # NOT the entries': a row of finite ~1e20 entries overflows its f32
+    # squared norm to Inf and behaves exactly like an Inf row in the Gram
+    # form (numpy_ref._krum_scores mirrors both).
+    diag = jnp.where(jnp.isfinite(sq), 0.0, jnp.inf)
+    return jnp.where(jnp.eye(k, dtype=bool), diag[:, None], dist)
 
 
 def krum_scores(wmatrix: jnp.ndarray, honest_size: int) -> jnp.ndarray:

@@ -183,17 +183,25 @@ def ring_krum_scores(
         )
         rows = accumulate(rows, blk, blk_sq, p - 1)
         # complete the d-sharded inner products, then apply the same
-        # non-finite-row guards as ops.aggregators.pairwise_sq_dists: the
+        # poisoned-row guards as ops.aggregators.pairwise_sq_dists: the
         # Gram form turns Inf rows into NaN distances (Inf - Inf), and a
         # NaN score sorts as BEST under top_k(-scores) — selecting the
         # poisoned row.  NaN -> +Inf (infinitely far), clamp cancellation,
-        # and force self-distances to their exact value 0.
+        # and set self-distances to their exact value 0 for well-formed
+        # rows but +Inf for poisoned ones (full squared norm non-finite —
+        # covers Inf/NaN entries AND finite rows whose f32 norm overflows),
+        # so a poisoned row scores Inf for ANY k_sel, including the
+        # degenerate honest_size=2 / k_sel=1 case.
         dist = jax.lax.psum(rows, MODEL_AXIS)
         dist = jnp.where(jnp.isnan(dist), jnp.inf, dist)
         dist = jnp.maximum(dist, 0.0)
+        full_sq = jax.lax.psum(my_sq, MODEL_AXIS)  # [k_loc]
+        self_val = jnp.where(jnp.isfinite(full_sq), 0.0, jnp.inf)
         self_col = me * k_loc + jnp.arange(k_loc)
         dist = jnp.where(
-            jnp.arange(k_total)[None, :] == self_col[:, None], 0.0, dist
+            jnp.arange(k_total)[None, :] == self_col[:, None],
+            self_val[:, None],
+            dist,
         )
         neg_top, _ = jax.lax.top_k(-dist, k_sel)
         return -jnp.sum(neg_top, axis=1)  # [k_loc]

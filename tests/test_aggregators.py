@@ -333,6 +333,30 @@ def test_signmv_matches_oracle(wmat):
     np.testing.assert_allclose(got_e, want_e, rtol=1e-5, atol=1e-6)
 
 
+def test_krum_degenerate_honest_size_2_rejects_poisoned_row():
+    # honest_size=2 -> k_sel=1: with the usual exact-0 diagonal a poisoned
+    # row's sorted distance row is [0, Inf, ...] and its score 0 — it would
+    # WIN the selection.  Both backends put +Inf on a poisoned row's
+    # diagonal so its score is Inf for any k_sel (round-4 advisor finding).
+    # "Poisoned" covers BOTH non-finite entries and finite ~1e20 entries
+    # whose f32 squared norm overflows (identical in the f32 Gram form).
+    for poison in (np.inf, 1e20):
+        rng = np.random.default_rng(7)
+        w = rng.normal(size=(3, 5)).astype(np.float32)
+        w[-1] = poison
+        scores = np.asarray(agg.krum_scores(jnp.asarray(w), honest_size=2))
+        assert np.isfinite(scores[:-1]).all(), poison
+        assert np.isinf(scores[-1]), poison
+        got = np.asarray(agg.krum(jnp.asarray(w), honest_size=2))
+        assert np.isfinite(got).all(), poison
+        want_scores = numpy_ref._krum_scores(w, honest_size=2)
+        assert np.isfinite(want_scores[:-1]).all()
+        assert np.isinf(want_scores[-1]), poison
+        np.testing.assert_allclose(
+            got, numpy_ref.krum(w, honest_size=2), rtol=1e-6, atol=1e-7
+        )
+
+
 def test_signmv_bounded_influence_and_majority():
     # honest clients all vote +1 on every coordinate (delta > 0); B < K/2
     # Byzantine rows with arbitrarily huge NEGATIVE deltas can neither flip

@@ -4,6 +4,8 @@ The oracle is the authority the JAX ops are tested against, so its own
 statistical/algebraic properties need independent coverage.
 """
 
+import warnings
+
 import numpy as np
 
 from byzantine_aircomp_tpu.backends import numpy_ref
@@ -187,4 +189,50 @@ def test_oracle_krum_inf_rows_warning_free_and_never_selected():
     from byzantine_aircomp_tpu.ops import aggregators as agg
 
     jsel = np.asarray(agg.krum(jnp.asarray(w), honest_size=6))
+    np.testing.assert_array_equal(sel, jsel)
+
+
+def test_gm_divergence_regime_transcribes_silently():
+    # the noise-dominated regime drives the Weiszfeld iterate to Inf (the
+    # reference physics); the NARROWED errstate guards (round-4 advisor)
+    # must still mask every warning downstream of divergence — including
+    # the Inf*0 in the message build when an excluded row's weight is 0 and
+    # the overflow inside oma2 on an Inf-laden message.  pyproject
+    # escalates backends/ RuntimeWarnings to errors, so any regression in
+    # the masked regions fails this test outright.
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(6, 8)).astype(np.float32)
+    w[-1] = np.inf  # excluded row -> inv = 0 -> Inf*0 in the msg build
+    diverged = np.full(8, 1e20, np.float32)  # scaler >> the 1e15 gate
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        out = numpy_ref.gm(rng, w, noise_var=1.0, guess=diverged, maxiter=3)
+    assert out.shape == (8,)
+
+
+def test_krum_colluding_huge_rows_band_matches_jax():
+    # rows with norm^2 just UNDER f32max pass the per-row poisoned test in
+    # both backends, but their PAIRWISE Gram-form terms overflow in f32:
+    # the JAX path sees Inf - Inf = NaN -> +Inf and rejects the colluding
+    # pair, while a pure-f64 oracle would compute their true distance (0)
+    # and elect one.  The oracle emulates the f32 overflow so the backends
+    # agree (review follow-up to the round-4 advisor finding).
+    import jax.numpy as jnp
+
+    from byzantine_aircomp_tpu.ops import aggregators as agg
+
+    rng = np.random.default_rng(11)
+    w = rng.normal(size=(8, 16)).astype(np.float32)
+    w[4] = 6.3e18  # norm^2 ~ 1.98e38 < f32max each ...
+    w[5] = 6.3e18  # ... but sq_i + sq_j ~ 3.97e38 > f32max
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        scores = numpy_ref._krum_scores(w, honest_size=6)
+        sel = numpy_ref.krum(w, honest_size=6)
+    jscores = np.asarray(agg.krum_scores(jnp.asarray(w), honest_size=6))
+    jsel = np.asarray(agg.krum(jnp.asarray(w), honest_size=6))
+    # neither backend may elect a colluding huge row
+    assert not np.any(sel == np.float32(6.3e18))
+    assert not np.any(jsel == np.float32(6.3e18))
+    assert np.argmin(scores) == np.argmin(jscores)
     np.testing.assert_array_equal(sel, jsel)

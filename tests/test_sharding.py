@@ -202,6 +202,23 @@ def test_ring_krum_scores_inf_row_matches_dense():
     np.testing.assert_allclose(got[:-1], want[:-1], rtol=1e-3, atol=1e-3)
 
 
+def test_ring_krum_degenerate_honest_size_2_matches_dense():
+    # k_sel=1 degenerate case: the poisoned row's self-distance must be
+    # +Inf (not the usual exact 0) in the RING formulation too, or the
+    # sharded path would select a row the dense path and oracle reject
+    # (round-4 advisor finding + its review follow-up)
+    m = mesh_lib.make_mesh(model_parallel=2)
+    for poison in (jnp.inf, 1e20):
+        w = jax.random.normal(jax.random.PRNGKey(9), (16, 256))
+        w = w.at[-1, :].set(poison)
+        got = np.asarray(collective.ring_krum_scores(m, w, honest_size=2))
+        want = np.asarray(agg_lib.krum_scores(w, honest_size=2))
+        assert np.isinf(want[-1]) and not np.isnan(want[-1]), poison
+        assert np.isinf(got[-1]) and not np.isnan(got[-1]), poison
+        sel = np.asarray(collective.ring_krum(m, w, honest_size=2))
+        assert np.isfinite(sel).all(), poison
+
+
 def test_ring_krum_and_bulyan_survive_inf_row():
     # a rejected Inf row must not reach the output through the one-hot
     # extractions (0*Inf = NaN without the row masks), for either sign
