@@ -25,7 +25,6 @@ fields accept "none").
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
 import sys
 import time
@@ -34,7 +33,7 @@ import typing
 from byzantine_aircomp_tpu.fed.config import FedConfig
 from byzantine_aircomp_tpu.fed.train import FedTrainer
 
-_FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(FedConfig)}
+_FIELD_TYPES = typing.get_type_hints(FedConfig)
 
 
 def _coerce(name: str, raw: str):
@@ -42,8 +41,6 @@ def _coerce(name: str, raw: str):
     if name not in _FIELD_TYPES:
         raise SystemExit(f"unknown FedConfig field {name!r}")
     tp = _FIELD_TYPES[name]
-    if isinstance(tp, str):  # from __future__ annotations
-        tp = eval(tp, vars(typing), {"Optional": typing.Optional})  # noqa: S307
     origin = typing.get_origin(tp)
     if origin is typing.Union:  # Optional[...]
         args = [a for a in typing.get_args(tp) if a is not type(None)]
@@ -59,8 +56,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", required=True, help="JSONL output path")
     p.add_argument(
-        "--set", nargs="+", default=[], metavar="KEY=VALUE",
-        help="FedConfig overrides",
+        "--set", nargs="+", action="extend", default=[], metavar="KEY=VALUE",
+        help="FedConfig overrides (repeatable; occurrences accumulate)",
     )
     args = p.parse_args(argv)
 

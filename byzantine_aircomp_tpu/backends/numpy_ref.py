@@ -72,7 +72,13 @@ def _krum_scores(w: np.ndarray, honest_size: int) -> np.ndarray:
     # ops.aggregators.pairwise_sq_dists).
     dist[bad, bad] = np.inf
     k_sel = honest_size - 2 + 1
-    return np.sort(dist, axis=1)[:, :k_sel].sum(axis=1)
+    scores = np.sort(dist, axis=1)[:, :k_sel].sum(axis=1)
+    # the f32 emulation must extend to the SCORE level too: in the
+    # colluding band the distances are huge-but-finite in f64 while the
+    # JAX path's f32 top_k sum saturates to Inf — saturate to match, so
+    # rejected rows rank identically (all Inf) in both backends
+    scores[scores > f32max] = np.inf
+    return scores
 
 
 def krum(w: np.ndarray, honest_size: int) -> np.ndarray:

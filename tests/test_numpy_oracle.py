@@ -223,16 +223,24 @@ def test_krum_colluding_huge_rows_band_matches_jax():
 
     rng = np.random.default_rng(11)
     w = rng.normal(size=(8, 16)).astype(np.float32)
-    w[4] = 6.3e18  # norm^2 ~ 1.98e38 < f32max each ...
-    w[5] = 6.3e18  # ... but sq_i + sq_j ~ 3.97e38 > f32max
+    w[4] = 3.5e18  # norm^2 = 16*(3.5e18)^2 ~ 1.96e38 < f32max each ...
+    w[5] = 3.5e18  # ... but sq_i + sq_j ~ 3.92e38 > f32max
+    # the rows must NOT be individually poisoned, or this test never
+    # reaches the pair_over band (review catch: 6.3e18 rows overflow
+    # their own norm and take the per-row bad path instead)
+    assert (np.float64(3.5e18) ** 2) * 16 < np.finfo(np.float32).max
     with warnings.catch_warnings():
         warnings.simplefilter("error", RuntimeWarning)
         scores = numpy_ref._krum_scores(w, honest_size=6)
         sel = numpy_ref.krum(w, honest_size=6)
     jscores = np.asarray(agg.krum_scores(jnp.asarray(w), honest_size=6))
     jsel = np.asarray(agg.krum(jnp.asarray(w), honest_size=6))
-    # neither backend may elect a colluding huge row
-    assert not np.any(sel == np.float32(6.3e18))
-    assert not np.any(jsel == np.float32(6.3e18))
+    # neither backend may elect a colluding huge row, and the rejected
+    # rows' scores must saturate to Inf in BOTH backends (the oracle's
+    # f64 score sum would otherwise stay finite where f32 top_k is Inf)
+    assert np.isinf(scores[4]) and np.isinf(scores[5])
+    assert np.isinf(jscores[4]) and np.isinf(jscores[5])
+    assert not np.any(sel == np.float32(3.5e18))
+    assert not np.any(jsel == np.float32(3.5e18))
     assert np.argmin(scores) == np.argmin(jscores)
     np.testing.assert_array_equal(sel, jsel)
