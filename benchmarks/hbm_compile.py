@@ -1,0 +1,82 @@
+"""Compile-only memory analysis for a training-round program.
+
+Lowers and compiles the trainer's round function WITHOUT executing it and
+prints XLA's memory analysis (generated-code temp allocation + argument /
+output sizes).  This is how the single-chip memory ceiling is measured
+when no accelerator is attached: the dominant term (vmapped per-client
+activations vs the [K, d] stack) shows up in ``temp_size_in_bytes`` on
+any backend.  docs/PERFORMANCE.md's ResNet remat before/after table comes
+from this tool.
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      python benchmarks/hbm_compile.py \
+        --set dataset=cifar10 model=ResNet18 honest_size=90 byz_size=10 \
+              batch_size=50 attack=signflip agg=krum display_interval=10 \
+              eval_train=False remat=True
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from trajectory import _coerce  # same --set plumbing
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--set", nargs="+", action="extend", default=[], metavar="KEY=VALUE",
+        help="FedConfig overrides (repeatable)",
+    )
+    p.add_argument(
+        "--synthetic-train", type=int, default=2000,
+        help="synthetic dataset rows (memory analysis is data-size "
+             "independent; small keeps host prep cheap)",
+    )
+    args = p.parse_args(argv)
+
+    import jax
+
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+
+    kw = {}
+    for item in args.set:
+        k, _, v = item.partition("=")
+        kw[k] = _coerce(k, v)
+    kw.setdefault("rounds", 1)
+    cfg = FedConfig(**kw)
+    ds = data_lib.load(
+        cfg.dataset,
+        synthetic_train=args.synthetic_train,
+        synthetic_val=max(200, args.synthetic_train // 10),
+    )
+    tr = FedTrainer(cfg, dataset=ds)
+    key = jax.random.fold_in(tr._base_key, 0)
+    compiled = tr._round_fn.lower(
+        tr.flat_params, tr.server_opt_state, tr.client_m,
+        key, tr.x_train, tr.y_train,
+    ).compile()
+    mem = compiled.memory_analysis()
+    gib = 1024.0**3
+    out = {
+        "model": cfg.model,
+        "K": cfg.node_size,
+        "batch_size": cfg.batch_size,
+        "iterations": cfg.display_interval,
+        "d": int(tr.flat_params.shape[0]),
+        "remat": cfg.remat,
+        "backend": jax.default_backend(),
+        "temp_gib": round(mem.temp_size_in_bytes / gib, 3),
+        "argument_gib": round(mem.argument_size_in_bytes / gib, 3),
+        "output_gib": round(mem.output_size_in_bytes / gib, 3),
+        "alias_gib": round(mem.alias_size_in_bytes / gib, 3),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

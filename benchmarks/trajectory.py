@@ -59,6 +59,15 @@ def main(argv=None) -> int:
         "--set", nargs="+", action="extend", default=[], metavar="KEY=VALUE",
         help="FedConfig overrides (repeatable; occurrences accumulate)",
     )
+    p.add_argument(
+        "--synthetic-train", type=int, default=None,
+        help="synthetic train rows (default: the dataset's full size)",
+    )
+    p.add_argument(
+        "--synthetic-val", type=int, default=None,
+        help="synthetic val rows — smaller cuts per-round eval cost on CPU "
+             "rungs (2000 rows: ~1%% accuracy noise; state it when scaled)",
+    )
     args = p.parse_args(argv)
 
     kw = {}
@@ -66,11 +75,24 @@ def main(argv=None) -> int:
         k, _, v = item.partition("=")
         kw[k] = _coerce(k, v)
     cfg = FedConfig(**kw)
-    trainer = FedTrainer(cfg)
+    dataset = None
+    if args.synthetic_train is not None or args.synthetic_val is not None:
+        from byzantine_aircomp_tpu.data import datasets as data_lib
+
+        ds_kw = {}
+        if args.synthetic_train is not None:
+            ds_kw["synthetic_train"] = args.synthetic_train
+        if args.synthetic_val is not None:
+            ds_kw["synthetic_val"] = args.synthetic_val
+        dataset = data_lib.load(cfg.dataset, **ds_kw)
+    trainer = FedTrainer(cfg, dataset=dataset)
 
     t0 = time.perf_counter()
     with open(args.out, "w") as fh:
-        fh.write(json.dumps({"config": kw}) + "\n")
+        fh.write(json.dumps({"config": kw, "dataset_rows": [
+            int(trainer.dataset.x_train.shape[0]),
+            int(trainer.dataset.x_val.shape[0]),
+        ]}) + "\n")
         fh.flush()
         for r in range(cfg.rounds):
             trainer.run_round(r)

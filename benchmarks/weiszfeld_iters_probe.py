@@ -1,0 +1,60 @@
+"""Weiszfeld iteration counts on realistic federated stacks.
+
+Supports the docs/PERFORMANCE.md "large-d fused Weiszfeld" null: the
+aggregation cost per global iteration is (iters x 2 passes x K x d x 4B)
+of HBM traffic, so the iteration count is the load-bearing constant.
+Realistic stacks — clients one local SGD step apart (spread ~1e-3 of the
+param scale) — converge in 2-3 iterations at every model-family geometry,
+independent of d (checked explicitly).
+
+    python benchmarks/weiszfeld_iters_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+
+def count_iters(k, d, spread, seed, tol=1e-5, maxiter=1000):
+    rng = np.random.default_rng(seed)
+    g_true = rng.normal(size=d).astype(np.float32) * 0.05
+    w = g_true[None, :] + spread * rng.standard_normal((k, d)).astype(
+        np.float32
+    )
+    guess = w.mean(axis=0)
+    for i in range(maxiter):
+        dist = np.maximum(1e-4, np.linalg.norm(w - guess, axis=1))
+        inv = 1.0 / dist
+        nxt = (w * inv[:, None]).sum(axis=0) / inv.sum()
+        mv = np.linalg.norm(guess - nxt)
+        guess = nxt
+        if mv <= tol:
+            return i + 1
+    return maxiter
+
+
+def main():
+    out = {}
+    # d/8 keeps the host probe cheap; the d-independence check below
+    # justifies it (the count depends on the stack geometry, not d)
+    for name, k, d in (
+        ("mlp_k1000", 1000, 7850),
+        ("emnist_cnn_k200", 200, 6_603_710 // 8),
+        ("resnet_k50", 50, 11_173_962 // 8),
+    ):
+        out[name] = [
+            count_iters(k, d, s, seed)
+            for s in (1e-3, 1e-2)
+            for seed in (0, 1)
+        ]
+    out["d_independence_mlp"] = [
+        count_iters(100, 7850, 1e-3, 0),
+        count_iters(100, 785000, 1e-3, 0),
+    ]
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

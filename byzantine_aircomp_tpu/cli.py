@@ -105,6 +105,8 @@ ARG_TO_FIELD = {
     "dataset": ("dataset", None),
     "mark": ("mark", None),
     "cache_dir": ("cache_dir", None),
+    "resnet_width": ("resnet_width", None),
+    "remat": ("remat", None),
     "no_eval_train": ("eval_train", lambda v: not v),
     "eval_train": ("eval_train", None),
     "local_steps": ("local_steps", None),
@@ -170,6 +172,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--dataset", type=str, default="mnist")
     p.add_argument("--model", type=str, default="MLP")
+    p.add_argument(
+        "--resnet-width", type=int, default=64,
+        help="ResNet-18 stem width (64 = standard; smaller keeps the "
+             "topology for scaled trajectory runs, scaling stated)",
+    )
+    p.add_argument(
+        "--remat", action="store_true",
+        help="rematerialize residual-block activations in backward "
+             "(jax.checkpoint): trades FLOPs for the vmapped-clients "
+             "activation memory that sets the single-chip ResNet ceiling",
+    )
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--interval", type=int, default=10, help="displayInterval")
     p.add_argument("--batch-size", type=int, default=50)

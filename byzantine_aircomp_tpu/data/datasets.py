@@ -350,6 +350,23 @@ def cifar10(synthetic_train: int = 50000, synthetic_val: int = 10000, **_) -> Da
     )
 
 
+@DATASETS.register("cifar10_hard")
+def cifar10_hard(
+    synthetic_train: int = 50000, synthetic_val: int = 10000, **_
+) -> Dataset:
+    """Always-synthetic CIFAR-shaped set with the same 0.919 accuracy
+    ceiling as ``mnist_hard`` (uniform label resampling, p=0.09 over all 10
+    classes).  The plain synthetic fallback is separable enough that a
+    ResNet saturates ~1.0, where a robustness trajectory cannot
+    discriminate defenses; the pinned ceiling keeps ordering differences
+    visible.  Used by the BASELINE config-5 trajectory evidence
+    (docs/RESULTS.md); never loads from disk."""
+    return _synthetic(
+        "cifar10_hard", synthetic_train, synthetic_val, 10, (32, 32, 3),
+        CIFAR10_STATS, label_noise=0.09,
+    )
+
+
 def load(name: str, **kw) -> Dataset:
     return DATASETS.get(name)(**kw)
 

@@ -117,6 +117,13 @@ class FedConfig:
     model: str = "MLP"
     dataset: str = "mnist"
     fc_width: int = 1024
+    # ResNet knobs: stem width (64 = standard ResNet-18; smaller keeps the
+    # topology for scaled trajectory runs) and per-block activation
+    # rematerialization (jax.checkpoint), the HBM-for-FLOPs trade that
+    # lifts the vmapped-clients single-chip memory ceiling
+    # (docs/PERFORMANCE.md "no longer fits")
+    resnet_width: int = 64
+    remat: bool = False
     # client data partition: "contiguous" (the reference's equal slices,
     # approximately IID on an unsorted set, :238-239) or "dirichlet"
     # (label-skewed non-IID per Hsu et al. 2019 — the standard stress

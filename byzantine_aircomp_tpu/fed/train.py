@@ -92,6 +92,10 @@ class FedTrainer:
         model_kw = dict(num_classes=self.num_classes)
         if cfg.model == "CNN":
             model_kw["fc_width"] = cfg.fc_width
+        # factories swallow unknown kwargs (**_), so the ResNet knobs can
+        # ride along unconditionally without touching MLP/CNN
+        model_kw["width"] = cfg.resnet_width
+        model_kw["remat"] = cfg.remat
         self.model = MODELS.get(cfg.model)(**model_kw)
 
         # init params (reference modelFactory + setup_seed(2021), :98-104).

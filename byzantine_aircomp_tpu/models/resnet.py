@@ -66,13 +66,35 @@ class ResNet18(nn.Module):
     num_classes: int = 10
     stage_sizes: Sequence[int] = (2, 2, 2, 2)
     dtype: Any = jnp.float32
+    # stem width; stage i uses width * 2**i (64 = the standard ResNet-18).
+    # Smaller widths keep the topology for CPU-scaled trajectory runs
+    # (docs/RESULTS.md states the scaling wherever they appear).
+    width: int = 64
+    # rematerialize each residual block's activations in the backward pass
+    # (jax.checkpoint via nn.remat): the federated trainer vmaps the local
+    # step over K clients, so activation memory scales K-fold and is THE
+    # single-chip ceiling at ResNet scale (docs/PERFORMANCE.md) — remat
+    # trades one extra forward per block for an O(depth) cut in saved
+    # activations, the classic HBM-for-FLOPs exchange.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x):
         if x.ndim == 3:
             x = x[..., None]
+        if self.width % 8:
+            raise ValueError(
+                f"ResNet18 width must be a multiple of 8 (GroupNorm groups), "
+                f"got {self.width}"
+            )
+        # nn.remat returns a renamed class (CheckpointBasicBlock) and flax
+        # derives both the param-tree keys and the init RNG folds from
+        # module names — so blocks carry EXPLICIT names matching the
+        # non-remat auto-naming, keeping init bit-identical and
+        # checkpoints interchangeable whether remat is on or off
+        block_cls = nn.remat(BasicBlock) if self.remat else BasicBlock
         x = nn.Conv(
-            64,
+            self.width,
             kernel_size=(3, 3),
             use_bias=False,
             kernel_init=xavier_normal_relu(),
@@ -80,11 +102,16 @@ class ResNet18(nn.Module):
         )(x)
         x = nn.GroupNorm(num_groups=8, dtype=self.dtype)(x)
         x = nn.relu(x)
+        n_block = 0
         for i, block_count in enumerate(self.stage_sizes):
-            features = 64 * 2**i
+            features = self.width * 2**i
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = BasicBlock(features, strides=strides, dtype=self.dtype)(x)
+                x = block_cls(
+                    features, strides=strides, dtype=self.dtype,
+                    name=f"BasicBlock_{n_block}",
+                )(x)
+                n_block += 1
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(
             self.num_classes,
@@ -94,5 +121,10 @@ class ResNet18(nn.Module):
 
 
 @MODELS.register("ResNet18", aliases=("resnet18",))
-def make_resnet18(num_classes: int = 10, dtype=jnp.float32, **_):
-    return ResNet18(num_classes=num_classes, dtype=dtype)
+def make_resnet18(
+    num_classes: int = 10, dtype=jnp.float32, width: int = 64,
+    remat: bool = False, **_,
+):
+    return ResNet18(
+        num_classes=num_classes, dtype=dtype, width=width, remat=remat
+    )

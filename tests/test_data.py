@@ -207,3 +207,26 @@ def test_ref_backend_uses_same_dirichlet_split_as_jax():
     )
     assert r_iid["valAccPath"] != r_skew["valAccPath"]
     assert r_skew["valAccPath"][-1] > 0.15
+
+
+def test_cifar10_hard_ceiling_and_shape():
+    # same pinned-Bayes-ceiling construction as mnist_hard (p=0.09 uniform
+    # resampling over all 10 classes -> 0.919), CIFAR-shaped, for the
+    # BASELINE config-5 trajectory evidence
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+
+    ds = data_lib.load("cifar10_hard", synthetic_train=2000, synthetic_val=500)
+    assert ds.x_train.shape == (2000, 32, 32, 3)
+    assert ds.num_classes == 10
+    # label noise present: the TRAIN labels sit at the same rng stream
+    # position in both variants (protos, then y, then x), so the flip
+    # fraction is directly observable there (p*(C-1)/C = 8.1% expected);
+    # val streams diverge because the hard variant consumes extra draws
+    clean = data_lib.load("cifar10", synthetic_train=2000, synthetic_val=500)
+    if clean.source != "synthetic":
+        import pytest
+
+        pytest.skip("real CIFAR-10 on disk; the flip-fraction comparison "
+                    "needs the synthetic fallback's shared rng stream")
+    frac = float((ds.y_train != clean.y_train).mean())
+    assert 0.04 < frac < 0.13, frac

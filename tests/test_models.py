@@ -81,3 +81,33 @@ def test_resnet18_forward_shape():
     n = _n_params(params)
     # ResNet-18 CIFAR ~11.2M params
     assert 10_000_000 < n < 12_000_000
+
+
+def test_resnet18_width_and_remat_knobs():
+    import pytest
+
+    # scaled width keeps the ResNet-18 topology (8 blocks over 4 stages)
+    # while shrinking d quadratically — the CPU-scaled trajectory rungs
+    # (docs/RESULTS.md) state this scaling explicitly
+    x = jnp.ones((1, 32, 32, 3))
+    narrow = MODELS.get("ResNet18")(num_classes=10, width=16)
+    p16 = narrow.init(jax.random.PRNGKey(0), x)
+    assert _n_params(p16) == 701466  # measured; ~11.2M / 16
+    assert any(k.startswith("BasicBlock_7") for k in p16["params"])
+
+    # remat must not move a single parameter: block names are pinned so
+    # flax's name-derived init RNG folds identically (nn.remat otherwise
+    # renames modules to CheckpointBasicBlock_* and changes init)
+    remat = MODELS.get("ResNet18")(num_classes=10, width=16, remat=True)
+    pr = remat.init(jax.random.PRNGKey(0), x)
+    for a, b in zip(jax.tree.leaves(p16), jax.tree.leaves(pr)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and the forward pass is identical too
+    np.testing.assert_array_equal(
+        np.asarray(narrow.apply(p16, x)), np.asarray(remat.apply(pr, x))
+    )
+
+    with pytest.raises(ValueError, match="multiple of 8"):
+        MODELS.get("ResNet18")(num_classes=10, width=12).init(
+            jax.random.PRNGKey(0), x
+        )
