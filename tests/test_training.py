@@ -129,6 +129,36 @@ def test_results_matrix_headline_claims():
     assert krum > 0.75, f"krum should survive weightflip: {krum}"
 
 
+@pytest.mark.slow
+def test_dataflip_matrix_claim_selection_beats_averaging():
+    """Executable lock on the dataflip row (docs/RESULTS.md): data-level
+    inversion stays inside the honest envelope, so SELECTION defenses hold
+    the baseline while every AVERAGING rule is dragged — mean measurably
+    below krum at the matrix's own operating point."""
+    ds = data_lib.load("mnist_hard", synthetic_train=12000, synthetic_val=3000)
+    kw = dict(
+        honest_size=16,
+        byz_size=4,
+        attack="dataflip",
+        rounds=5,
+        display_interval=10,
+        batch_size=32,
+        eval_train=False,
+    )
+
+    def final(agg):
+        cfg = FedConfig(**{**kw, "agg": agg})
+        return FedTrainer(cfg, dataset=ds).train()["valAccPath"][-1]
+
+    krum = final("krum")
+    mean = final("mean")
+    assert krum > 0.78, f"krum should hold the baseline under dataflip: {krum}"
+    assert krum - mean > 0.05, (
+        f"dataflip should drag the average below the selection: "
+        f"krum={krum} mean={mean}"
+    )
+
+
 def test_variance_metric_recorded():
     paths = run_short(make_cfg(rounds=2))
     assert len(paths["variencePath"]) == 2
