@@ -20,6 +20,12 @@ Usage (CPU-scaled EMNIST rung, docs/RESULTS.md):
 Any `FedConfig` field can be set via ``--set key=value``; values are
 coerced by the dataclass field type (bool accepts True/False, Optional
 fields accept "none").
+
+Output format: line 1 is the ``{"config", "dataset_rows"}`` header, then
+one ``{"round", "val_loss", "val_acc", "secs"}`` row per round.  With
+``--checkpoint-dir``, a resumed run appends after a ``{"resumed": N}``
+seam marker — ``secs`` is per-process wall clock and restarts at each
+seam.
 """
 
 from __future__ import annotations
@@ -68,6 +74,14 @@ def main(argv=None) -> int:
         help="synthetic val rows — smaller cuts per-round eval cost on CPU "
              "rungs (2000 rows: ~1%% accuracy noise; state it when scaled)",
     )
+    p.add_argument(
+        "--checkpoint-dir", default=None,
+        help="save trainer state here each round and RESUME from it when "
+             "present, so extending a schedule does not re-tread completed "
+             "rounds (per-round fold_in keys make the resumed trajectory "
+             "bit-identical to an uninterrupted run); appends to --out on "
+             "resume",
+    )
     args = p.parse_args(argv)
 
     kw = {}
@@ -87,16 +101,59 @@ def main(argv=None) -> int:
         dataset = data_lib.load(cfg.dataset, **ds_kw)
     trainer = FedTrainer(cfg, dataset=dataset)
 
+    start_round = 0
+    ckpt_title = None
+    if args.checkpoint_dir:
+        import jax
+
+        from byzantine_aircomp_tpu.fed import checkpoint as ckpt_lib
+
+        # this runner checkpoints FLAT PARAMS only; configs with extra
+        # cross-round state need the harness's full resume (fed/harness.py)
+        if cfg.server_opt != "none" or cfg.client_momentum:
+            raise SystemExit(
+                "--checkpoint-dir here supports plain-SGD configs only; "
+                "use the CLI harness --checkpoint-dir/--inherit for "
+                "server-opt or client-momentum runs"
+            )
+        # config-derived title so differently-configured cells sharing one
+        # checkpoint dir can never silently resume each other's state
+        # (the exact hazard fed/harness.py::run_title exists to prevent)
+        from byzantine_aircomp_tpu.fed.harness import run_title
+
+        ckpt_title = run_title(cfg)
+        restored = ckpt_lib.load(args.checkpoint_dir, ckpt_title)
+        if restored is not None:
+            start_round, flat, _ = restored
+            trainer.flat_params = jax.device_put(
+                flat, trainer.flat_params.sharding
+            )
+            print(f"resumed at round {start_round}", file=sys.stderr)
+
     t0 = time.perf_counter()
-    with open(args.out, "w") as fh:
-        fh.write(json.dumps({"config": kw, "dataset_rows": [
-            int(trainer.dataset.x_train.shape[0]),
-            int(trainer.dataset.x_val.shape[0]),
-        ]}) + "\n")
-        fh.flush()
-        for r in range(cfg.rounds):
+    with open(args.out, "a") as fh:
+        if fh.tell() == 0:  # fresh file: always lead with the header line
+            fh.write(json.dumps({"config": kw, "dataset_rows": [
+                int(trainer.dataset.x_train.shape[0]),
+                int(trainer.dataset.x_val.shape[0]),
+            ]}) + "\n")
+            fh.flush()
+        if start_round:
+            # seam marker: `secs` is per-process wall clock, so cumulative
+            # analyses must restart at each resume line
+            fh.write(json.dumps({"resumed": start_round}) + "\n")
+            fh.flush()
+        for r in range(start_round, cfg.rounds):
             trainer.run_round(r)
             loss, acc = trainer.evaluate("val")
+            # checkpoint BEFORE appending the row: a crash between the two
+            # leaves a visible gap (row r missing) rather than a silent
+            # duplicate that would double-count in tail-window means
+            if args.checkpoint_dir:
+                ckpt_lib.save(
+                    args.checkpoint_dir, ckpt_title, r + 1,
+                    trainer.flat_params,
+                )
             row = {
                 "round": r,
                 "val_loss": round(float(loss), 4),
