@@ -137,3 +137,28 @@ def test_reproduce_main_pipeline(tmp_path, monkeypatch):
     assert out.exists() and out.stat().st_size > 0
     # all 8 runs must reach the figure — run_title alone collides on B
     assert seen["n"] == 8
+
+
+def test_trajectory_plot_renders(tmp_path):
+    # the JSONL trajectory plotter must tolerate seam markers and duplicate
+    # rounds (crash-resume overlap: last row wins) and render a PNG
+    import json
+
+    from byzantine_aircomp_tpu.analysis import trajectory_plot
+
+    p = tmp_path / "t.jsonl"
+    rows = [
+        {"config": {"agg": "gm2"}, "dataset_rows": [100, 20]},
+        {"round": 0, "val_loss": 2.0, "val_acc": 0.1, "secs": 1.0},
+        {"round": 1, "val_loss": 1.5, "val_acc": 0.3, "secs": 2.0},
+        {"resumed": 1},
+        {"round": 1, "val_loss": 1.5, "val_acc": 0.35, "secs": 1.0},
+        {"round": 2, "val_loss": 1.0, "val_acc": 0.5, "secs": 2.0},
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    header, rounds, accs = trajectory_plot.load_trajectory(str(p))
+    assert rounds == [0, 1, 2]
+    assert accs == [0.1, 0.35, 0.5]  # duplicate round 1: last row wins
+    out = tmp_path / "t.png"
+    trajectory_plot.main([f"gm2={p}", "--out", str(out)])
+    assert out.exists() and out.stat().st_size > 0
