@@ -1,0 +1,221 @@
+"""Fault-tolerance matrix: run (aggregator x fault x attack) cells and
+tabulate accuracy + survival.
+
+The defense-vs-attack sweep (:mod:`.sweep`) answers "which aggregator
+survives which ADVERSARY"; this tool answers the robustness question the
+deployment story adds: which aggregator survives which NON-adversarial
+failure mode (``ops/faults.py``) — alone and COMPOSED with an attack.  Each
+cell trains from scratch and reports final val accuracy, whether the global
+params stayed finite EVERY round (the receiver finite-guard working), and
+the minimum per-round effective client count observed:
+
+    python -m byzantine_aircomp_tpu.analysis.fault_matrix \
+        --aggs gm2,krum,trimmed_mean --faults none,dropout,chaos \
+        --attacks none,classflip --K 20 --B 4 --rounds 5
+
+Output: one JSON line per cell on stdout, a markdown table per attack on
+stderr, and optionally an atomic pickle of the full grid (``--out``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..fed.config import FedConfig
+from ..fed.train import FedTrainer
+from ..registry import AGGREGATORS, ATTACKS, FAULTS
+from ..utils import io as io_lib
+
+Cell = Tuple[str, Optional[str], Optional[str]]  # (agg, fault, attack)
+
+
+def run_cell(
+    agg: str, fault: Optional[str], attack: Optional[str], cfg_kw: dict, dataset
+) -> Dict[str, float]:
+    """Train one (aggregator, fault, attack) cell.
+
+    Beyond the sweep's accuracy metrics this records the SURVIVAL facts:
+    ``finite_all_rounds`` (did the finite-guard keep the global model finite
+    through every round) and, when a fault is active, ``min_effective_k``
+    (the worst per-round count of clients whose rows actually landed) plus
+    the total dropped/erased/corrupted event counts.
+    """
+    kw = dict(cfg_kw)
+    kw["agg"] = agg
+    kw["attack"] = attack
+    kw["fault"] = fault
+    if attack is None and kw.get("byz_size"):
+        kw["byz_size"] = 0  # reference semantics (run(), :430-431)
+    cfg = FedConfig(**kw)
+    trainer = FedTrainer(cfg, dataset=dataset)
+    finite_all = True
+    min_eff_k = float(cfg.node_size)
+    dropped = erased = corrupt = 0.0
+    for r in range(cfg.rounds):
+        trainer.run_round(r)
+        finite_all = finite_all and bool(
+            np.isfinite(np.asarray(trainer.flat_params)).all()
+        )
+        if fault is not None:
+            d, e, c, eff_k = (
+                float(v) for v in np.asarray(trainer.last_fault_metrics)
+            )
+            dropped, erased, corrupt = dropped + d, erased + e, corrupt + c
+            min_eff_k = min(min_eff_k, eff_k)
+    loss, acc = trainer.evaluate("val")
+    metrics: Dict[str, float] = {
+        "val_acc": round(acc, 4),
+        "val_loss": round(loss, 4),
+        "finite_all_rounds": finite_all,
+    }
+    if fault is not None:
+        metrics.update(
+            min_effective_k=min_eff_k,
+            dropped=dropped,
+            erased=erased,
+            corrupt=corrupt,
+        )
+    return metrics
+
+
+def run_matrix(
+    aggs: List[str],
+    faults: List[Optional[str]],
+    attacks: List[Optional[str]],
+    cfg_kw: dict,
+    dataset=None,
+    log=lambda s: print(s, file=sys.stderr, flush=True),
+    on_cell=None,
+) -> Dict[Cell, Dict[str, float]]:
+    """The full cube; dataset is loaded once and shared across cells."""
+    from ..data import datasets as data_lib
+
+    for a in aggs:
+        AGGREGATORS.get(a)  # fail fast on typos, before any training
+    for f in faults:
+        if f is not None:
+            FAULTS.get(f)
+    for t in attacks:
+        if t is not None:
+            ATTACKS.get(t)
+    if dataset is None:
+        dataset = data_lib.load(cfg_kw.get("dataset", "mnist"))
+    grid: Dict[Cell, Dict[str, float]] = {}
+    for attack in attacks:
+        for fault in faults:
+            for agg in aggs:
+                cell = run_cell(agg, fault, attack, cfg_kw, dataset)
+                grid[(agg, fault, attack)] = cell
+                log(
+                    f"[fault_matrix] agg={agg} fault={fault} "
+                    f"attack={attack}: {cell}"
+                )
+                if on_cell is not None:
+                    on_cell(agg, fault, attack, cell)
+    return grid
+
+
+def markdown_table(
+    grid: Dict[Cell, Dict[str, float]], metric: str = "val_acc"
+) -> str:
+    """One ``fault x agg`` table per attack; non-finite cells are flagged
+    with ``!`` so a survival failure can't hide behind a plausible number."""
+    aggs = sorted({a for a, _, _ in grid})
+    faults = sorted(
+        {f for _, f, _ in grid}, key=lambda f: (f is not None, f)
+    )
+    attacks = sorted(
+        {t for _, _, t in grid}, key=lambda t: (t is not None, t)
+    )
+    blocks = []
+    for t in attacks:
+        head = (
+            f"**attack: {t or 'none'}**\n\n| fault \\ agg | "
+            + " | ".join(aggs)
+            + " |"
+        )
+        sep = "|" + "---|" * (len(aggs) + 1)
+        rows = []
+        for f in faults:
+            cells = []
+            for a in aggs:
+                c = grid[(a, f, t)]
+                mark = "" if c["finite_all_rounds"] else " !"
+                cells.append(f"{c[metric]:.4f}{mark}")
+            rows.append(f"| {f or 'none'} | " + " | ".join(cells) + " |")
+        blocks.append("\n".join([head, sep] + rows))
+    return "\n\n".join(blocks)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--aggs", default="gm2,krum,trimmed_mean,mean")
+    ap.add_argument("--faults", default="none,dropout,deep_fade,csi,corrupt,chaos")
+    ap.add_argument("--attacks", default="none,classflip")
+    ap.add_argument("--dataset", default="mnist")
+    ap.add_argument("--K", type=int, default=20)
+    ap.add_argument("--B", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--interval", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=50)
+    ap.add_argument("--gamma", type=float, default=1e-2)
+    ap.add_argument("--var", type=float, default=None)
+    ap.add_argument("--seed", type=int, default=2021)
+    ap.add_argument("--out", default=None, help="pickle the grid here")
+    args = ap.parse_args(argv)
+
+    aggs = [a for a in args.aggs.split(",") if a]
+    faults: List[Optional[str]] = [
+        None if f in ("none", "") else f for f in args.faults.split(",")
+    ]
+    attacks: List[Optional[str]] = [
+        None if t in ("none", "") else t for t in args.attacks.split(",")
+    ]
+    cfg_kw = dict(
+        dataset=args.dataset,
+        honest_size=args.K - args.B,
+        byz_size=args.B,
+        rounds=args.rounds,
+        display_interval=args.interval,
+        batch_size=args.batch_size,
+        gamma=args.gamma,
+        noise_var=args.var,
+        seed=args.seed,
+        eval_train=False,
+    )
+    grid = run_matrix(
+        aggs,
+        faults,
+        attacks,
+        cfg_kw,
+        on_cell=lambda agg, fault, attack, cell: print(
+            json.dumps(
+                {
+                    "agg": agg,
+                    "fault": fault or "none",
+                    "attack": attack or "none",
+                    **cell,
+                }
+            ),
+            flush=True,
+        ),
+    )
+    print(markdown_table(grid), file=sys.stderr, flush=True)
+    if args.out:
+        io_lib.atomic_pickle(
+            args.out,
+            {
+                f"{a}|{f or 'none'}|{t or 'none'}": c
+                for (a, f, t), c in grid.items()
+            },
+        )
+        print(f"[fault_matrix] grid pickled to {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

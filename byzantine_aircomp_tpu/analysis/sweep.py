@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import pickle
 import sys
 import time
 from typing import Dict, List, Optional, Tuple
@@ -27,6 +26,7 @@ from ..cli import add_knob_flags
 from ..fed.config import FedConfig
 from ..fed.train import FedTrainer
 from ..registry import AGGREGATORS, ATTACKS
+from ..utils import io as io_lib
 
 
 def run_cell(
@@ -200,6 +200,13 @@ def main(argv=None) -> None:
         dnc_iters=args.dnc_iters,
         dnc_sub_dim=args.dnc_sub_dim,
         dnc_c=args.dnc_c,
+        fault=args.fault,
+        dropout_prob=args.dropout_prob,
+        fade_floor=args.fade_floor,
+        csi_std=args.csi_std,
+        corrupt_prob=args.corrupt_prob,
+        corrupt_mode=args.corrupt_mode,
+        corrupt_size=args.corrupt_size,
     )
     grid = run_sweep(
         aggs,
@@ -213,8 +220,9 @@ def main(argv=None) -> None:
     )
     print(markdown_table(grid), file=sys.stderr, flush=True)
     if args.out:
-        with open(args.out, "wb") as f:
-            pickle.dump({f"{a}|{t or 'none'}": c for (a, t), c in grid.items()}, f)
+        io_lib.atomic_pickle(
+            args.out, {f"{a}|{t or 'none'}": c for (a, t), c in grid.items()}
+        )
         print(f"[sweep] grid pickled to {args.out}", file=sys.stderr)
 
 

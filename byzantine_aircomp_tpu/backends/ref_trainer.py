@@ -256,6 +256,14 @@ def run_ref(cfg: FedConfig, log_fn=print, dataset=None) -> Dict:
     if cfg.attack is None:
         cfg.byz_size = 0
     cfg.validate()
+    if cfg.fault is not None:
+        # the NumPy oracle reproduces the reference line-by-line; the
+        # reference has no fault model, so an oracle run with faults on
+        # would silently compare against a DIFFERENT program
+        raise NotImplementedError(
+            "ref backend has no fault-injection path; run --backend jax "
+            "or drop --fault"
+        )
     _KNOWN_ATTACKS = {
         "classflip", "dataflip", "gradascent", "weightflip", "signflip",
         "alie", "ipm", "gaussian", "minmax", "minsum",

@@ -67,6 +67,30 @@ def add_knob_flags(p) -> None:
     p.add_argument("--dnc-c", type=float, default=1.0,
                    help="dnc removal multiplier: ceil(c*B) flagged per "
                         "round (agg=dnc)")
+    # fault-injection surface (ops/faults.py); knob flags override the
+    # registered scenario's defaults and require --fault
+    p.add_argument("--fault", type=str, default=None,
+                   help="fault scenario name (dropout, deep_fade, csi, "
+                        "corrupt, chaos); None = ideal deployment")
+    p.add_argument("--dropout-prob", type=float, default=None,
+                   help="per-round client dropout probability (stale-update "
+                        "replay); overrides the --fault scenario")
+    p.add_argument("--fade-floor", type=float, default=None,
+                   help="deep-fade outage threshold on |h|^2 (rows below "
+                        "are erased); overrides the --fault scenario")
+    p.add_argument("--csi-std", type=float, default=None,
+                   help="CSI estimation error log-magnitude std; overrides "
+                        "the --fault scenario")
+    p.add_argument("--corrupt-prob", type=float, default=None,
+                   help="per-round payload-corruption probability for the "
+                        "faulty clients; overrides the --fault scenario")
+    p.add_argument("--corrupt-mode", choices=["nan", "inf", "saturate"],
+                   default=None,
+                   help="corrupted payload value class; overrides the "
+                        "--fault scenario")
+    p.add_argument("--corrupt-size", type=int, default=None,
+                   help="number of corruption-eligible (honest) clients; "
+                        "overrides the --fault scenario")
 
 
 ARG_TO_FIELD = {
@@ -93,6 +117,13 @@ ARG_TO_FIELD = {
     "dnc_iters": ("dnc_iters", None),
     "dnc_sub_dim": ("dnc_sub_dim", None),
     "dnc_c": ("dnc_c", None),
+    "fault": ("fault", None),
+    "dropout_prob": ("dropout_prob", None),
+    "fade_floor": ("fade_floor", None),
+    "csi_std": ("csi_std", None),
+    "corrupt_prob": ("corrupt_prob", None),
+    "corrupt_mode": ("corrupt_mode", None),
+    "corrupt_size": ("corrupt_size", None),
     "profile_dir": ("profile_dir", None),
     "model_parallel": ("model_parallel", None),
     "rounds": ("rounds", None),

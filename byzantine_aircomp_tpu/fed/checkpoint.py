@@ -14,10 +14,11 @@ structured params pytrees and multi-host sharded saves.
 from __future__ import annotations
 
 import os
-import tempfile
 from typing import Optional, Tuple
 
 import numpy as np
+
+from ..utils import io as io_lib
 
 
 def checkpoint_file(ckpt_dir: str, title: str) -> str:
@@ -29,22 +30,15 @@ def save(
 ) -> str:
     """Write params (+ optional server-optimizer state leaves, in pytree-leaf
     order) atomically."""
-    os.makedirs(ckpt_dir, exist_ok=True)
     path = checkpoint_file(ckpt_dir, title)
     # materialize host copies BEFORE acquiring the fd: a device error here
     # must not leak the tmp file
     flat_host = np.asarray(flat_params)
     extras = {f"opt_{i}": np.asarray(leaf) for i, leaf in enumerate(opt_leaves)}
-    fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, round_idx=round_idx, flat_params=flat_host, **extras)
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
-    return path
+    return io_lib.atomic_write(
+        path,
+        lambda f: np.savez(f, round_idx=round_idx, flat_params=flat_host, **extras),
+    )
 
 
 def load(

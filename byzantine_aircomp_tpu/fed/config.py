@@ -57,6 +57,22 @@ class FedConfig:
     agg: str = "gm"
     attack: Optional[str] = None
     noise_var: Optional[float] = None
+    # non-adversarial fault injection (ops/faults.py): a registered
+    # FaultSpec name ("dropout", "deep_fade", "csi", "corrupt", "chaos")
+    # or None = the ideal deployment (bit-identical to the pre-fault
+    # program — no fault code is traced).  The knobs below OVERRIDE the
+    # named spec's defaults when not None; setting any of them without
+    # --fault is an error (they would silently do nothing)
+    fault: Optional[str] = None
+    dropout_prob: Optional[float] = None
+    fade_floor: Optional[float] = None
+    csi_std: Optional[float] = None
+    corrupt_prob: Optional[float] = None
+    corrupt_mode: Optional[str] = None
+    corrupt_size: Optional[int] = None
+    ge_p_gb: Optional[float] = None
+    ge_p_bg: Optional[float] = None
+    ge_bad_mult: Optional[float] = None
 
     # aggregator options (reference options dict, :350)
     agg_maxiter: int = 1000
@@ -203,6 +219,20 @@ class FedConfig:
     def node_size(self) -> int:
         return self.honest_size + self.byz_size
 
+    _FAULT_KNOBS = (
+        "dropout_prob", "fade_floor", "csi_std", "corrupt_prob",
+        "corrupt_mode", "corrupt_size", "ge_p_gb", "ge_p_bg", "ge_bad_mult",
+    )
+
+    def fault_overrides(self) -> dict:
+        """The non-None fault knobs, as ``dataclasses.replace`` overrides
+        for the named FaultSpec (ops/faults.resolve)."""
+        return {
+            k: getattr(self, k)
+            for k in self._FAULT_KNOBS
+            if getattr(self, k) is not None
+        }
+
     def validate(self):
         # reference asserts (MNIST_Air_weight.py:229-230)
         assert self.byz_size == 0 or self.attack is not None, (
@@ -305,4 +335,27 @@ class FedConfig:
         assert self.server_opt in ("none", "momentum", "adam"), (
             f"server_opt must be none|momentum|adam, got {self.server_opt!r}"
         )
+        overrides = self.fault_overrides()
+        if self.fault is None:
+            assert not overrides, (
+                f"fault knobs {sorted(overrides)} require fault= to be set "
+                f"(they override a named FaultSpec and would otherwise "
+                f"silently do nothing)"
+            )
+        else:
+            # resolve + spec-level validation up front so an unknown fault
+            # name or out-of-range knob fails here, not at trace time
+            from ..ops import faults as fault_lib
+
+            spec = fault_lib.resolve(self.fault, overrides)
+            assert self.participation == 1.0, (
+                "fault injection requires full participation: the stale-"
+                "replay buffer and Gilbert-Elliott state are [K]-indexed "
+                "by the full client stack"
+            )
+            assert spec.corrupt_size <= self.honest_size, (
+                f"corrupt_size {spec.corrupt_size} exceeds the "
+                f"{self.honest_size} honest clients (corruption models "
+                f"crashed honest senders; Byzantine rows are the attack's)"
+            )
         return self

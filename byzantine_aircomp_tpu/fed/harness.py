@@ -13,7 +13,6 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
-import pickle
 import sys
 import time
 from typing import Dict, Optional
@@ -21,6 +20,7 @@ from typing import Dict, Optional
 import jax.numpy as jnp
 
 from ..data import datasets as data_lib
+from ..utils import io as io_lib
 from . import checkpoint
 from .config import FedConfig
 from .train import FedTrainer
@@ -101,6 +101,13 @@ def run_title(cfg: FedConfig) -> str:
         # prefixed like _prng above: a bare _bf16 would collide with
         # --mark bf16 on a default-dtype run
         title += f"_stack{cfg.stack_dtype}"
+    if cfg.fault is not None:
+        # fault scenario + any overridden knobs: a chaos run and a
+        # fault-free run must never alias on checkpoints/pickles, and two
+        # chaos runs at different dropout rates must not either
+        title += f"_fault{cfg.fault}"
+        for knob, val in sorted(cfg.fault_overrides().items()):
+            title += f"_{knob.replace('_', '')}{val}"
     if cfg.mark:
         title += f"_{cfg.mark}"
     return title
@@ -200,12 +207,14 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
         import jax
 
         # everything beyond flat params that must survive a resume:
-        # server-optimizer state and the client-momentum buffer, as one
-        # pytree so the leaf-count match covers both
+        # server-optimizer state, the client-momentum buffer, and the
+        # fault-injection carry (stale-update buffer + Gilbert-Elliott
+        # channel state), as one pytree so the leaf-count match covers all
         def _extra_state(t):
             return (
                 getattr(t, "server_opt_state", ()),
                 getattr(t, "client_m", ()),
+                getattr(t, "fault_state", ()),
             )
 
         checkpoint_fn = lambda r, t: checkpoint.save(
@@ -227,7 +236,7 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
                 own_state = _extra_state(trainer)
                 own_leaves = jax.tree.leaves(own_state)
                 if len(extra_leaves) == len(own_leaves) and extra_leaves:
-                    server_state, client_m = jax.tree.unflatten(
+                    server_state, client_m, fault_state = jax.tree.unflatten(
                         jax.tree.structure(own_state),
                         [
                             jax.device_put(l, own.sharding)
@@ -237,6 +246,8 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
                     trainer.server_opt_state = server_state
                     if not isinstance(client_m, tuple):  # () when disabled
                         trainer.client_m = client_m
+                    if jax.tree.leaves(fault_state):  # ()-only when disabled
+                        trainer.fault_state = fault_state
                 elif len(extra_leaves) != len(own_leaves):
                     log(
                         "WARNING: checkpoint extra state "
@@ -294,7 +305,13 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
         # framework extras
         "roundsPerSec": paths["roundsPerSec"],
     }
+    if cfg.fault is not None:
+        record["fault"] = cfg.fault
+        record["faultOverrides"] = cfg.fault_overrides()
+        record["faultDroppedPath"] = paths["faultDroppedPath"]
+        record["faultErasedPath"] = paths["faultErasedPath"]
+        record["faultCorruptPath"] = paths["faultCorruptPath"]
+        record["effectiveKPath"] = paths["effectiveKPath"]
     if record_in_file:
-        with open(path, "wb") as f:
-            pickle.dump(record, f)
+        io_lib.atomic_pickle(path, record)
     return record

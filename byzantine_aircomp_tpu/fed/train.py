@@ -43,6 +43,7 @@ from ..data import datasets as data_lib
 from ..ops import aggregators as agg_lib
 from ..ops import attacks as attack_lib
 from ..ops import channel as channel_lib
+from ..ops import faults as fault_lib
 from ..ops import flatten as flatten_lib
 from ..registry import DATASETS, MODELS
 from .config import FedConfig
@@ -86,6 +87,7 @@ class FedTrainer:
             cfg.dataset
         )
         self.attack = attack_lib.resolve(cfg.attack)
+        self.fault = fault_lib.resolve(cfg.fault, cfg.fault_overrides())
         self.agg_fn = agg_lib.resolve(cfg.agg)
         self.num_classes = self.dataset.num_classes
 
@@ -213,6 +215,20 @@ class FedTrainer:
             else ()
         )
 
+        # fault-injection state (ops/faults.py): the stale-replay buffer
+        # and Gilbert-Elliott channel-state bools, carried across rounds
+        # like client_m.  () when faults are off, so the default program's
+        # carry (and its donation) is cost-free.  The sharded trainer
+        # re-lays the [K, d] buffer out over the clients axis afterwards.
+        self.fault_state = (
+            fault_lib.init_state(self.fault, cfg.node_size, self.flat_params)
+            if self.fault is not None
+            else ()
+        )
+        # per-round [dropped, erased, corrupt, effective_k] from the last
+        # executed round ((), i.e. absent, when faults are off)
+        self.last_fault_metrics = ()
+
         # per-round key stream; model init above stays threefry so initial
         # params are identical whatever impl drives the round RNG.  Typed
         # keys (jax.random.key) carry their impl — a raw PRNGKey array of a
@@ -224,12 +240,14 @@ class FedTrainer:
         self._base_key = jax.random.key(cfg.seed, impl=impl)
 
         copts = self._jit_compiler_options()
+        # arg 3 is the fault state — an empty pytree when faults are off,
+        # so its donation slot contributes no buffers to the default program
         self._round_fn = jax.jit(
-            self._build_round_fn(), donate_argnums=(0, 1, 2),
+            self._build_round_fn(), donate_argnums=(0, 1, 2, 3),
             compiler_options=copts,
         )
         self._multi_round_fn = jax.jit(
-            self._build_multi_round_fn(), donate_argnums=(0, 1, 2),
+            self._build_multi_round_fn(), donate_argnums=(0, 1, 2, 3),
             compiler_options=copts,
         )
         self._eval_fn = jax.jit(self._build_eval_fn(), compiler_options=copts)
@@ -336,14 +354,24 @@ class FedTrainer:
         behind a ``lax.cond``: the reference computes ``getVarience`` ONCE per
         round on the last iteration's stack (``:360-361``), so the other
         ``display_interval - 1`` iterations skip the extra [honest, d]
-        passes entirely."""
+        passes entirely.
+
+        With ``cfg.fault`` set the carry gains the fault state and the
+        iteration emits ``(variance, [dropped, erased, corrupt,
+        effective_k])``; every fault stage is gated at TRACE time on
+        ``self.fault``, so the fault-free program (structure, RNG stream,
+        outputs) is bit-identical to the pre-fault one."""
         cfg = self.cfg
-        flat_params, opt_state, client_m = carry
+        flat_params, opt_state, client_m, fault_state = carry
         m_h, m_b = self._part_h, self._part_b
         # extra keys exist only on the programs that need them, so the
         # default configuration consumes the exact default RNG stream
         # (checkpoint/replay compatible)
-        n_extra = int(cfg.participation < 1.0) + int(cfg.bucket_size > 1)
+        n_extra = (
+            int(cfg.participation < 1.0)
+            + int(cfg.bucket_size > 1)
+            + int(self.fault is not None)
+        )
         keys = jax.random.split(key, 4 + n_extra)
         k_batch, k_chan, k_agg, k_msg = keys[:4]
         next_extra = 4
@@ -366,6 +394,9 @@ class FedTrainer:
             offsets, sizes = self.offsets, self.sizes
         if cfg.bucket_size > 1:
             k_bucket = keys[next_extra]
+            next_extra += 1
+        if self.fault is not None:
+            k_drop, k_trans = jax.random.split(keys[next_extra])
 
         with jax.named_scope("client_local_step"):
             # E local steps per client, each on a fresh with-replacement
@@ -407,6 +438,20 @@ class FedTrainer:
                 )
             w_stack = self._constrain_stack(w_stack)
 
+        n_dropped = n_erased = n_corrupt = None
+        if self.fault is not None:
+            with jax.named_scope("fault_dropout"):
+                # PRE-attack: the stale buffer records what clients SENT,
+                # never what an omniscient message attack rewrote (and a
+                # corrupted NaN emission can never poison future replays)
+                stale, ge_bad = fault_state
+                w_stack, stale, n_dropped = fault_lib.apply_dropout(
+                    self.fault, k_drop, w_stack, stale
+                )
+                if self.fault.needs_stale:
+                    stale = self._constrain_stack(stale)
+                    w_stack = self._constrain_stack(w_stack)
+
         with jax.named_scope("message_attack"):
             # called even when m_b == 0: apply_message validates
             # attack_param BEFORE its no-op early-out, so a bogus knob
@@ -415,6 +460,18 @@ class FedTrainer:
                 w_stack = self.attack.apply_message(
                     w_stack, m_b, k_msg, param=cfg.attack_param
                 )
+
+        if self.fault is not None:
+            with jax.named_scope("fault_transmission"):
+                # POST-attack: corruption and channel impairments hit the
+                # transmitted stack, Byzantine rows included
+                w_stack, ge_bad, n_erased, n_corrupt = (
+                    fault_lib.apply_transmission(
+                        self.fault, k_trans, w_stack, ge_bad
+                    )
+                )
+                w_stack = self._constrain_stack(w_stack)
+            fault_state = (stale, ge_bad)
 
         with jax.named_scope("channel"):
             if cfg.noise_var is not None and agg_lib.needs_oma_prepass(cfg.agg):
@@ -470,8 +527,21 @@ class FedTrainer:
                 dnc_iters=cfg.dnc_iters,
                 dnc_sub_dim=cfg.dnc_sub_dim,
                 dnc_c=cfg.dnc_c,
+                # graceful degradation (ops/aggregators.py): under faults
+                # the static rules adapt to the per-round effective K;
+                # False traces the literal pre-fault aggregator code
+                degraded=self.fault is not None,
             )
             aggregated = aggregated.astype(jnp.float32)
+            if self.fault is not None:
+                # receiver-side finite-guard — the last line of defense the
+                # fault contract promises: whatever non-finite value leaks
+                # through aggregation (e.g. zero clients delivered anything
+                # finite this round), the global model holds position
+                # instead of being NaNed for the rest of training
+                aggregated = jnp.where(
+                    jnp.isfinite(aggregated), aggregated, flat_params
+                )
             if self._server_tx is not None:
                 # FedOpt: the aggregate defines a pseudo-gradient
                 delta = flat_params - aggregated
@@ -488,12 +558,30 @@ class FedTrainer:
             lambda w: jnp.float32(0.0),
             w_stack,
         )
-        return (new_flat, opt_state, client_m), variance
+        carry_out = (new_flat, opt_state, client_m, fault_state)
+        if self.fault is not None:
+            # effective K = finite rows the receiver actually aggregates
+            # over (post-fault, pre-bucketing); the other three are this
+            # iteration's fault event counts
+            eff_k = jnp.sum(agg_lib._finite_rows(w_stack)).astype(jnp.float32)
+            fault_metrics = jnp.stack(
+                [n_dropped, n_erased, n_corrupt, eff_k]
+            )
+            return carry_out, (variance, fault_metrics)
+        return carry_out, variance
 
     def _round_core(
-        self, flat_params, opt_state, client_m, round_key, x_train, y_train
+        self, flat_params, opt_state, client_m, fault_state, round_key,
+        x_train, y_train
     ):
-        """One round (display_interval scanned iterations) as a pure fn."""
+        """One round (display_interval scanned iterations) as a pure fn.
+
+        Returns ``(params, opt_state, client_m, fault_state, variance,
+        fault_metrics)`` where fault_metrics is the round's reduced
+        [dropped, erased, corrupt, effective_k] (event counts summed over
+        the interval, effective K at its per-iteration MINIMUM — the
+        worst moment is what resilience claims are about) — or ``()``
+        with faults off, keeping that program's output structure free."""
         interval = self.cfg.display_interval
         keys = jax.random.split(round_key, interval)
         want = jnp.arange(interval) == interval - 1
@@ -502,10 +590,18 @@ class FedTrainer:
             key, want_var = kf
             return self._iteration(carry, key, x_train, y_train, want_var)
 
-        (final, opt_final, m_final), variances = jax.lax.scan(
-            it, (flat_params, opt_state, client_m), (keys, want)
+        (final, opt_final, m_final, f_final), out = jax.lax.scan(
+            it, (flat_params, opt_state, client_m, fault_state), (keys, want)
         )
-        return final, opt_final, m_final, variances[-1]
+        if self.fault is not None:
+            variances, fm = out  # fm: [interval, 4]
+            fault_metrics = jnp.concatenate(
+                [jnp.sum(fm[:, :3], axis=0), jnp.min(fm[:, 3:], axis=0)]
+            )
+        else:
+            variances = out
+            fault_metrics = ()
+        return final, opt_final, m_final, f_final, variances[-1], fault_metrics
 
     def _build_round_fn(self):
         return self._round_core
@@ -522,19 +618,25 @@ class FedTrainer:
         tests/test_training.py::test_run_rounds_matches_run_round_loop)."""
         base_key = self._base_key
 
-        def multi_fn(flat_params, opt_state, client_m, rounds, x_train, y_train):
+        def multi_fn(
+            flat_params, opt_state, client_m, fault_state, rounds,
+            x_train, y_train,
+        ):
             def body(carry, r):
-                fp, os, cm = carry
-                fp, os, cm, var = self._round_core(
-                    fp, os, cm, jax.random.fold_in(base_key, r),
+                fp, os, cm, fs = carry
+                fp, os, cm, fs, var, fm = self._round_core(
+                    fp, os, cm, fs, jax.random.fold_in(base_key, r),
                     x_train, y_train,
                 )
-                return (fp, os, cm), var
+                return (fp, os, cm, fs), (var, fm)
 
-            (final, opt_final, m_final), variances = jax.lax.scan(
-                body, (flat_params, opt_state, client_m), rounds
+            (final, opt_final, m_final, f_final), (variances, fms) = (
+                jax.lax.scan(
+                    body, (flat_params, opt_state, client_m, fault_state),
+                    rounds,
+                )
             )
-            return final, opt_final, m_final, variances
+            return final, opt_final, m_final, f_final, variances, fms
 
         return multi_fn
 
@@ -596,10 +698,11 @@ class FedTrainer:
         they actually consume the value."""
         round_key = jax.random.fold_in(self._base_key, round_idx)
         (
-            self.flat_params, self.server_opt_state, self.client_m, variance
+            self.flat_params, self.server_opt_state, self.client_m,
+            self.fault_state, variance, self.last_fault_metrics,
         ) = self._round_fn(
             self.flat_params, self.server_opt_state, self.client_m,
-            round_key, self.x_train, self.y_train,
+            self.fault_state, round_key, self.x_train, self.y_train,
         )
         return variance
 
@@ -613,10 +716,16 @@ class FedTrainer:
         rounds, e.g. benchmarking."""
         rounds = jnp.arange(start_round, start_round + num_rounds, dtype=jnp.int32)
         (
-            self.flat_params, self.server_opt_state, self.client_m, variances
+            self.flat_params, self.server_opt_state, self.client_m,
+            self.fault_state, variances, fms,
         ) = self._multi_round_fn(
-            self.flat_params, self.server_opt_state, self.client_m, rounds,
-            self.x_train, self.y_train,
+            self.flat_params, self.server_opt_state, self.client_m,
+            self.fault_state, rounds, self.x_train, self.y_train,
+        )
+        # [num_rounds, 4] under faults (the LAST round's row is what
+        # run_round would have reported); () otherwise
+        self.last_fault_metrics = (
+            fms[-1] if self.fault is not None else ()
         )
         return variances
 
@@ -651,6 +760,15 @@ class FedTrainer:
             "variencePath": [],  # sic — reference spelling, draw.ipynb consumes it
             "roundsPerSec": [],
         }
+        if self.fault is not None:
+            # per-round fault observability: event counts summed over the
+            # round's iterations, plus the round's MINIMUM effective K
+            # (finite rows actually aggregated) — the resilience metric
+            # the fault-matrix sweep and the acceptance criteria read
+            paths["faultDroppedPath"] = []
+            paths["faultErasedPath"] = []
+            paths["faultCorruptPath"] = []
+            paths["effectiveKPath"] = []
         log(
             f"[0/{cfg.rounds}](interval: {cfg.display_interval}) "
             f"train: loss={tr_loss:.4f} acc={tr_acc:.4f} "
@@ -672,6 +790,18 @@ class FedTrainer:
             var_str = (
                 f" var={cfg.noise_var:.2e}" if cfg.noise_var is not None else ""
             )
+            if self.fault is not None:
+                dropped, erased, corrupt, eff_k = (
+                    float(v) for v in np.asarray(self.last_fault_metrics)
+                )
+                paths["faultDroppedPath"].append(dropped)
+                paths["faultErasedPath"].append(erased)
+                paths["faultCorruptPath"].append(corrupt)
+                paths["effectiveKPath"].append(eff_k)
+                var_str += (
+                    f" effK={eff_k:.0f} drop={dropped:.0f} "
+                    f"erase={erased:.0f} corrupt={corrupt:.0f}"
+                )
             log(
                 f"[{r + 1}/{cfg.rounds}](interval: {cfg.display_interval}) "
                 f"train: loss={tr_loss:.4f} acc={tr_acc:.4f} "

@@ -70,38 +70,84 @@ def _finite_centroid(wmatrix, finite):
 
 
 @AGGREGATORS.register("mean")
-def mean(wmatrix: jnp.ndarray, **_) -> jnp.ndarray:
+def mean(wmatrix: jnp.ndarray, *, degraded: bool = False, **_) -> jnp.ndarray:
     """Column mean (reference ``mean``, ``:186-187``).
 
     The f32 upcast keeps the ACCUMULATION f32 whatever the stack dtype
     (--stack-dtype bf16); XLA fuses the convert into the reduce, so a
-    bf16 stack still pays only bf16 HBM reads."""
+    bf16 stack still pays only bf16 HBM reads.
+
+    ``degraded`` (the fault-injection contract — see docs/DESIGN.md "Fault
+    model"): average only the finite rows, so one NaN-emitting crashed
+    client erases itself instead of the whole aggregate.  With zero finite
+    rows the result is NaN and the trainer's receiver finite-guard keeps
+    the previous global params."""
+    if degraded:
+        finite = _finite_rows(wmatrix)
+        return jnp.where(
+            jnp.sum(finite) > 0, _finite_centroid(wmatrix, finite), jnp.nan
+        )
     return jnp.mean(wmatrix.astype(jnp.float32), axis=0)
 
 
 @AGGREGATORS.register("median")
-def median(wmatrix: jnp.ndarray, **_) -> jnp.ndarray:
+def median(wmatrix: jnp.ndarray, *, degraded: bool = False, **_) -> jnp.ndarray:
     """Coordinatewise median, torch semantics (lower-middle for even K).
 
     Reference ``median`` (``:194-195``) uses ``torch.median(dim=0)`` which
     returns the ``(K-1)//2``-th order statistic, not the midpoint average.
+
+    ``degraded``: the median of the n finite rows — non-finite rows sort to
+    +Inf and the order statistic index becomes the DYNAMIC ``(n-1)//2``, so
+    the rule adapts to the per-round effective K instead of drifting toward
+    the +Inf tail.  n = 0 returns +Inf (trainer finite-guard territory).
     """
     k = wmatrix.shape[0]
+    if degraded:
+        finite = _finite_rows(wmatrix)
+        n = jnp.sum(finite)
+        srt = jnp.sort(
+            jnp.where(finite[:, None], wmatrix, jnp.inf), axis=0
+        )
+        idx = jnp.maximum(n - 1, 0) // 2
+        return jax.lax.dynamic_index_in_dim(srt, idx, axis=0, keepdims=False)
     srt = jnp.sort(wmatrix, axis=0)
     return srt[(k - 1) // 2]
 
 
 @AGGREGATORS.register("trimmed_mean")
 def trimmed_mean(
-    wmatrix: jnp.ndarray, *, trim_ratio: float = 0.1, beta: Optional[int] = None, **_
+    wmatrix: jnp.ndarray, *, trim_ratio: float = 0.1,
+    beta: Optional[int] = None, degraded: bool = False, **_
 ) -> jnp.ndarray:
     """Coordinatewise beta-trimmed mean.
 
     beta = floor(K * trim_ratio) rows are dropped at each extreme per
     coordinate, matching the reference's chained double-``topk``
     (``:189-192``) which keeps the middle K - 2*beta order statistics.
+
+    ``degraded``: the trim budget adapts to the per-round effective K —
+    b = floor(n * trim_ratio) over the n finite rows (an explicit ``beta``
+    is clamped to (n-1)//2 so the kept middle band is never empty); the
+    static-shape sort keeps non-finite rows at +Inf and a dynamic rank mask
+    selects the kept band.  n = 0 returns NaN (trainer finite-guard).
     """
     k = wmatrix.shape[0]
+    if degraded:
+        finite = _finite_rows(wmatrix)
+        n = jnp.sum(finite)
+        if beta is None:
+            b = (n * trim_ratio).astype(jnp.int32)
+        else:
+            b = jnp.minimum(int(beta), jnp.maximum(n - 1, 0) // 2)
+        srt = jnp.sort(jnp.where(finite[:, None], wmatrix, jnp.inf), axis=0)
+        ranks = jnp.arange(k)[:, None]
+        keep = jnp.logical_and(ranks >= b, ranks < n - b)
+        total = jnp.sum(
+            jnp.where(keep, srt, 0.0).astype(jnp.float32), axis=0
+        )
+        kept_n = jnp.maximum(n - 2 * b, 1)
+        return jnp.where(n > 0, total / kept_n, jnp.nan)
     b = int(k * trim_ratio) if beta is None else int(beta)
     srt = jnp.sort(wmatrix, axis=0)
     kept = jax.lax.slice_in_dim(srt, b, k - b, axis=0)
@@ -168,17 +214,54 @@ def krum_scores(wmatrix: jnp.ndarray, honest_size: int) -> jnp.ndarray:
     return -jnp.sum(neg_top, axis=1)
 
 
+def krum_scores_degraded(
+    wmatrix: jnp.ndarray, honest_size: int
+) -> jnp.ndarray:
+    """Krum scores whose neighbor count adapts to the per-round effective K.
+
+    With n finite rows the neighbor sum runs over the
+    ``c = clip(min(honest_size - 1, n - 1), 1, K)`` nearest rows — the
+    static ``top_k(k_sel)`` of :func:`krum_scores` would demand more
+    neighbors than exist when n shrinks below honest_size and every score
+    would be +Inf.  Static shapes are kept by sorting the full distance row
+    and masking ranks >= c (a DYNAMIC cutoff).  Non-finite rows score +Inf:
+    their sorted rows are all-Inf, and a rank mask alone would sum them to
+    0 — the best possible score — handing the aggregate to the crashed row.
+    """
+    k = wmatrix.shape[0]
+    dist = pairwise_sq_dists(wmatrix)
+    finite = _finite_rows(wmatrix)
+    n = jnp.sum(finite)
+    c = jnp.clip(jnp.minimum(honest_size - 1, n - 1), 1, k)
+    srt = jnp.sort(dist, axis=1)
+    ranks = jnp.arange(k)[None, :]
+    in_budget = jnp.logical_and(ranks < c, jnp.isfinite(srt))
+    scores = jnp.sum(jnp.where(in_budget, srt, 0.0), axis=1)
+    return jnp.where(finite, scores, jnp.inf)
+
+
 @AGGREGATORS.register("krum", aliases=("Krum",))
-def krum(wmatrix: jnp.ndarray, *, honest_size: int, **_) -> jnp.ndarray:
+def krum(
+    wmatrix: jnp.ndarray, *, honest_size: int, degraded: bool = False, **_
+) -> jnp.ndarray:
     """Single-Krum: return the client vector minimizing the Krum score
-    (reference ``Krum``, ``:197-204``)."""
-    scores = krum_scores(wmatrix, honest_size)
+    (reference ``Krum``, ``:197-204``).
+
+    ``degraded``: scores via :func:`krum_scores_degraded`, so selection
+    keeps working when faults shrink the finite row count below
+    honest_size.  With ZERO finite rows every score is +Inf, argmin picks
+    row 0 (non-finite) and the trainer finite-guard rejects it."""
+    if degraded:
+        scores = krum_scores_degraded(wmatrix, honest_size)
+    else:
+        scores = krum_scores(wmatrix, honest_size)
     return wmatrix[jnp.argmin(scores)]
 
 
 @AGGREGATORS.register("multi_krum")
 def multi_krum(
-    wmatrix: jnp.ndarray, *, honest_size: int, m: Optional[int] = None, **_
+    wmatrix: jnp.ndarray, *, honest_size: int, m: Optional[int] = None,
+    degraded: bool = False, **_
 ) -> jnp.ndarray:
     """Multi-Krum: average the m lowest-scoring clients.
 
@@ -190,8 +273,34 @@ def multi_krum(
     instead of ``mean(wmatrix[idx])``: the gather would materialize an
     [m, d] copy — ~40 GB at the ResNet-18 rung (m=900, d=11.2M, f32) —
     while the matvec reads the stack once and writes only [d].
+
+    ``degraded``: adaptive-neighbor scores plus a selection that averages
+    only the FINITE rows among the m winners — when fewer than m finite
+    rows exist, +Inf-scored (non-finite) rows necessarily land in the
+    static top_k and must not contribute.  Zero finite selected rows
+    returns NaN (trainer finite-guard).
     """
     m_sel = honest_size if m is None else int(m)
+    if degraded:
+        scores = krum_scores_degraded(wmatrix, honest_size)
+        _, idx = jax.lax.top_k(-scores, m_sel)
+        keep = _finite_rows(wmatrix)[idx]
+        count = jnp.sum(keep)
+        weights = jnp.zeros(wmatrix.shape[0], jnp.float32).at[idx].set(
+            keep.astype(jnp.float32) / jnp.maximum(count, 1)
+        )
+
+        def wmean(cols):
+            masked = jnp.where(weights[:, None] > 0, cols, 0.0)
+            return jnp.dot(weights, masked, preferred_element_type=jnp.float32)
+
+        k, d = wmatrix.shape
+        out = (
+            wmean(wmatrix)
+            if k * d <= _DENSE_MAX_ELEMS
+            else _blocked_columns(wmatrix, wmean)
+        )
+        return jnp.where(count > 0, out, jnp.nan)
     scores = krum_scores(wmatrix, honest_size)
     _, idx = jax.lax.top_k(-scores, m_sel)
     k, d = wmatrix.shape
@@ -414,7 +523,7 @@ def centered_clip(
 
 @AGGREGATORS.register("bulyan")
 def bulyan(
-    wmatrix: jnp.ndarray, *, honest_size: int, **_
+    wmatrix: jnp.ndarray, *, honest_size: int, degraded: bool = False, **_
 ) -> jnp.ndarray:
     """Bulyan (El Mhamdi et al., ICML 2018) — not in the reference (which
     ships single-Krum only, ``:197-204``); included as the standard stronger
@@ -425,10 +534,23 @@ def bulyan(
     values closest to the selected set's median.  Requires K > 4B (theta and
     beta both nonempty; B = K - honest_size), checked statically at trace
     time.
+
+    ``degraded``: Bulyan's theta/beta sizing is deeply static (two nested
+    selections), so the graceful-degradation rule is IMPUTATION — non-finite
+    rows are replaced with the finite-row centroid before the normal static
+    pipeline runs.  An imputed row is maximally inoffensive (it sits at the
+    crowd's center, biasing no coordinate median), which the matrix tests
+    check against the exact adaptive alternatives.  Zero finite rows
+    returns NaN (trainer finite-guard).
     """
     k, d = wmatrix.shape
     b = k - honest_size
     theta, beta = bulyan_sizes(k, b)
+    if degraded:
+        finite = _finite_rows(wmatrix)
+        cent = _finite_centroid(wmatrix, finite).astype(wmatrix.dtype)
+        wmatrix = jnp.where(finite[:, None], wmatrix, cent[None, :])
+        wmatrix = jnp.where(jnp.sum(finite) > 0, wmatrix, jnp.nan)
     scores = krum_scores(wmatrix, honest_size)
     _, idx = jax.lax.top_k(-scores, theta)
     if theta * d <= _DENSE_MAX_ELEMS:

@@ -32,6 +32,13 @@ import jax
 import jax.numpy as jnp
 
 
+# |h|^2 floor for channel-inversion divisions.  A deep fade (|h|^2 ~ 0,
+# probability ~eps for Rayleigh) would otherwise explode the equalization
+# residual to +-Inf and poison every downstream aggregate; physically a
+# receiver never inverts a channel it cannot estimate above its noise floor.
+HSQ_FLOOR = 1e-6
+
+
 def rayleigh_fade(key: jax.Array, k: int):
     """Per-client complex fade components h_r, h_i ~ N(0, 1/2), shape [K]."""
     kr, ki = jax.random.split(key)
@@ -39,6 +46,32 @@ def rayleigh_fade(key: jax.Array, k: int):
     h_r = std * jax.random.normal(kr, (k,), dtype=jnp.float32)
     h_i = std * jax.random.normal(ki, (k,), dtype=jnp.float32)
     return h_r, h_i
+
+
+def deep_fade_mask(h_sq: jnp.ndarray, fade_floor: float) -> jnp.ndarray:
+    """[K] bool: clients whose channel power sits below the truncation
+    threshold.  Under truncated channel inversion those clients are not
+    power-limited — they are OUTAGE: the receiver decodes nothing from them
+    (the fault layer maps their rows to NaN = "nothing received" and the
+    aggregators' finite-row exclusion drops them)."""
+    return h_sq < jnp.asarray(fade_floor, jnp.float32)
+
+
+def csi_error_scale(
+    key: jax.Array, k: int, csi_std: jnp.ndarray
+) -> jnp.ndarray:
+    """[K] per-client post-equalization magnitude scale under CSI error.
+
+    Log-normal model: the estimated fade magnitude is ``|h_hat| =
+    |h| * exp(eps)`` with ``eps ~ N(0, csi_std)`` (per-client std — the
+    Gilbert-Elliott bad state widens it), so zero-forcing equalization with
+    the WRONG estimate scales the delivered message by ``|h|/|h_hat| =
+    exp(-eps)``.  ``csi_std`` may be a scalar or a [K] vector.
+    """
+    eps = jnp.broadcast_to(jnp.asarray(csi_std, jnp.float32), (k,)) * (
+        jax.random.normal(key, (k,), dtype=jnp.float32)
+    )
+    return jnp.exp(-eps)
 
 
 def oma(key: jax.Array, message: jnp.ndarray, noise_var: float) -> jnp.ndarray:
@@ -54,7 +87,10 @@ def oma(key: jax.Array, message: jnp.ndarray, noise_var: float) -> jnp.ndarray:
     scale = jnp.sqrt(jnp.asarray(noise_var, jnp.float32))
     n_r = scale * jax.random.normal(key_nr, (k, d), dtype=jnp.float32)
     n_i = scale * jax.random.normal(key_ni, (k, d), dtype=jnp.float32)
-    h_sq = (h_r**2 + h_i**2)[:, None]
+    # the floor keeps a deep fade from exploding the residual to +-Inf
+    # (P(|h|^2 < HSQ_FLOOR) ~ 1e-6 per draw for unit-power Rayleigh, so
+    # draws above the floor are bit-identical to the unfloored division)
+    h_sq = jnp.maximum((h_r**2 + h_i**2)[:, None], HSQ_FLOOR)
     de_noise = (h_r[:, None] * n_r + h_i[:, None] * n_i) / h_sq
     return message + de_noise
 
@@ -77,7 +113,9 @@ def oma2(
     k, d = message.shape
     key_h, key_n = jax.random.split(key)
     h_r, h_i = rayleigh_fade(key_h, k)
-    h_sq = h_r**2 + h_i**2
+    # same deep-fade floor as oma: an exact-zero fade under a zero message
+    # would make p_message 0/0 = NaN and poison the truncation max below
+    h_sq = jnp.maximum(h_r**2 + h_i**2, HSQ_FLOOR)
     p_message = jnp.mean(message**2, axis=-1) / h_sq  # [K]
     p_upper = jnp.maximum(p_message, threshold)
     p_gain = jnp.sqrt(p_max / p_upper)  # [K]

@@ -1,0 +1,262 @@
+"""Non-adversarial fault injection — the imperfect-world counterpart of
+:mod:`.attacks`.
+
+The paper's threat model is adversarial clients over an otherwise ideal
+PHY; a deployed over-the-air FL system also fails NON-adversarially (BEV-SGD,
+arXiv:2110.09660; zero-trust OTA-FL, arXiv:2503.18284): stragglers deliver
+stale updates, deep fades erase clients mid-round, CSI is estimated with
+error, and a crashed client emits NaN into an analog superposition sum.  A
+:class:`FaultSpec` mirrors :class:`.attacks.AttackSpec` — a frozen, registered
+bundle of pure per-round transforms — with four orthogonal axes:
+
+* **dropout/straggler** (``dropout_prob``): each round a client fails to
+  deliver with probability p; the server replays that client's last
+  DELIVERED update from a carried [K, d] buffer (initialized to the global
+  init, so a round-0 dropout replays "no progress", not garbage).
+* **deep-fade erasure** (``fade_floor``): clients whose ``|h|^2`` falls below
+  the truncation threshold are in outage — their rows become NaN ("nothing
+  received") and the aggregators' finite-row exclusion drops them.
+* **CSI estimation error** (``csi_std``): zero-forcing equalization against
+  an estimate ``|h_hat| = |h| * exp(eps)`` scales the delivered row by
+  ``exp(-eps)``.  Errors are CORRELATED in time via a Gilbert-Elliott
+  good/bad channel state per client (a [K] bool carried through the scan):
+  in the bad state the error std widens by ``ge_bad_mult``.
+* **payload corruption** (``corrupt_prob``/``corrupt_mode``/``corrupt_size``):
+  up to ``corrupt_size`` of the FIRST (honest — Byzantine rows are the last
+  ``byz_size``) clients emit NaN / Inf / saturated floats with probability p
+  per round, modeling a crashed or overflowed sender rather than an attacker.
+
+Faults COMPOSE with attacks: dropout replay happens before the message
+attack (the stale buffer holds what clients sent, never what an omniscient
+attacker rewrote), corruption and channel impairments after it (they hit the
+transmitted stack, Byzantine rows included).  All state is jit-carried so the
+multi-round scan compiles once; with ``FedConfig.fault`` unset none of this
+code is traced and the round program is bit-identical to the fault-free one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import FAULTS
+from . import channel
+
+CORRUPT_MODES = ("nan", "inf", "saturate")
+# "saturate" emits the largest-magnitude finite f32 — a clipped/overflowed
+# sender.  Finite, so it exercises the aggregators' ROBUSTNESS (distance
+# filters), not their finite-row exclusion.
+SATURATE_VALUE = 3.0e38
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """A named non-adversarial failure mode (see module docstring).
+
+    All axes default OFF so any single registered fault stays orthogonal;
+    ``resolve`` overlays per-run config overrides with ``dataclasses.replace``,
+    which is how compound scenarios (the ``chaos`` preset) are built.
+    """
+
+    name: str
+    # dropout/straggler
+    dropout_prob: float = 0.0
+    # deep-fade erasure: outage threshold on |h|^2 (0 = off)
+    fade_floor: float = 0.0
+    # CSI estimation error (log-magnitude std; 0 = perfect CSI)
+    csi_std: float = 0.0
+    # Gilbert-Elliott correlation of the CSI error: P(good->bad),
+    # P(bad->good), and the bad-state std multiplier
+    ge_p_gb: float = 0.0
+    ge_p_bg: float = 1.0
+    ge_bad_mult: float = 5.0
+    # payload corruption
+    corrupt_prob: float = 0.0
+    corrupt_mode: str = "nan"
+    corrupt_size: int = 0
+
+    @property
+    def needs_stale(self) -> bool:
+        """Dropout carries the [K, d] last-delivered buffer."""
+        return self.dropout_prob > 0.0
+
+    @property
+    def needs_ge(self) -> bool:
+        """CSI error carries the [K] Gilbert-Elliott bad-state bools."""
+        return self.csi_std > 0.0
+
+    @property
+    def has_transmission(self) -> bool:
+        """Any post-attack (corruption / channel) impairment active."""
+        return (
+            self.corrupt_prob > 0.0
+            or self.fade_floor > 0.0
+            or self.csi_std > 0.0
+        )
+
+    def validate(self) -> "FaultSpec":
+        for f in ("dropout_prob", "corrupt_prob", "ge_p_gb", "ge_p_bg"):
+            v = getattr(self, f)
+            assert 0.0 <= v <= 1.0, f"{f} must be in [0, 1], got {v}"
+        assert self.fade_floor >= 0.0, (
+            f"fade_floor must be >= 0, got {self.fade_floor}"
+        )
+        assert self.csi_std >= 0.0, (
+            f"csi_std must be >= 0, got {self.csi_std}"
+        )
+        assert self.ge_bad_mult >= 1.0, (
+            f"ge_bad_mult must be >= 1 (the bad state widens the error), "
+            f"got {self.ge_bad_mult}"
+        )
+        assert self.corrupt_mode in CORRUPT_MODES, (
+            f"corrupt_mode must be one of {CORRUPT_MODES}, "
+            f"got {self.corrupt_mode!r}"
+        )
+        assert self.corrupt_size >= 0, (
+            f"corrupt_size must be >= 0, got {self.corrupt_size}"
+        )
+        assert not (self.corrupt_prob > 0.0) or self.corrupt_size >= 1, (
+            "corrupt_prob > 0 needs corrupt_size >= 1 faulty clients"
+        )
+        return self
+
+
+# ----------------------------------------------------------------------
+# registered failure scenarios (magnitudes are the documented defaults;
+# every knob is overridable per-run via FedConfig)
+
+FAULTS.register("dropout")(FaultSpec("dropout", dropout_prob=0.1))
+FAULTS.register("deep_fade")(FaultSpec("deep_fade", fade_floor=0.05))
+FAULTS.register("csi")(
+    FaultSpec("csi", csi_std=0.2, ge_p_gb=0.1, ge_p_bg=0.5)
+)
+FAULTS.register("corrupt")(
+    FaultSpec("corrupt", corrupt_prob=0.05, corrupt_mode="nan", corrupt_size=1)
+)
+FAULTS.register("chaos")(
+    FaultSpec(
+        "chaos",
+        dropout_prob=0.1,
+        fade_floor=0.05,
+        csi_std=0.2,
+        ge_p_gb=0.1,
+        ge_p_bg=0.5,
+        corrupt_prob=0.05,
+        corrupt_mode="nan",
+        corrupt_size=1,
+    )
+)
+
+
+def resolve(
+    name: Optional[str], overrides: Optional[dict] = None
+) -> Optional[FaultSpec]:
+    """Look up a fault by name and overlay non-None config overrides;
+    None means a fault-free (ideal) deployment."""
+    if name is None:
+        assert not overrides, (
+            f"fault knob overrides {sorted(overrides)} require --fault"
+        )
+        return None
+    spec = FAULTS.get(name)
+    if overrides:
+        spec = dataclasses.replace(spec, **overrides)
+    return spec.validate()
+
+
+# ----------------------------------------------------------------------
+# carried fault state
+
+FaultState = Tuple  # (stale [K, d] | (), ge_bad [K] bool | ())
+
+
+def init_state(spec: FaultSpec, k: int, flat_params: jnp.ndarray) -> FaultState:
+    """Initial scan-carried fault state for K clients.
+
+    The stale buffer starts as K copies of the initial global params: a
+    client that drops out before ever delivering replays "I am still at the
+    global init", which is the semantically correct zero-progress update.
+    Unused axes carry ``()`` so the fault-free parts of the program stay
+    cost-free (same idiom as the trainer's ``client_m``).
+    """
+    stale = (
+        jnp.zeros((k, flat_params.shape[0]), jnp.float32) + flat_params[None, :]
+        if spec.needs_stale
+        else ()
+    )
+    ge_bad = jnp.zeros((k,), bool) if spec.needs_ge else ()
+    return (stale, ge_bad)
+
+
+def apply_dropout(
+    spec: FaultSpec, key: jax.Array, w_stack: jnp.ndarray, stale
+):
+    """Straggler/dropout replay, PRE-attack.
+
+    Returns ``(delivered, new_stale, n_dropped)``: dropped rows are replaced
+    by that client's last delivered update, and the buffer advances to the
+    delivered stack — so a client dropped for several consecutive rounds
+    keeps replaying its last success, and the buffer never absorbs an
+    attacked or corrupted row (it is updated before those stages run).
+    """
+    if not spec.needs_stale:
+        return w_stack, stale, jnp.float32(0.0)
+    k = w_stack.shape[0]
+    dropped = jax.random.bernoulli(key, spec.dropout_prob, (k,))
+    delivered = jnp.where(dropped[:, None], stale, w_stack)
+    return delivered, delivered, jnp.sum(dropped).astype(jnp.float32)
+
+
+def apply_transmission(
+    spec: FaultSpec, key: jax.Array, w_stack: jnp.ndarray, ge_bad
+):
+    """Post-attack transmission impairments: payload corruption, then the
+    channel (CSI error + deep-fade erasure).
+
+    Returns ``(w_stack, new_ge_bad, n_erased, n_corrupt)``.  Corruption hits
+    the FIRST ``corrupt_size`` rows (the honest side — a crashed sender is a
+    fault, not an attacker); channel impairments hit every row.
+    """
+    k = w_stack.shape[0]
+    k_corrupt, k_fade, k_csi, k_ge = jax.random.split(key, 4)
+    n_corrupt = jnp.float32(0.0)
+    n_erased = jnp.float32(0.0)
+
+    if spec.corrupt_prob > 0.0:
+        eligible = jnp.arange(k) < spec.corrupt_size
+        crashed = jnp.logical_and(
+            eligible, jax.random.bernoulli(k_corrupt, spec.corrupt_prob, (k,))
+        )
+        bad = {
+            "nan": jnp.nan, "inf": jnp.inf, "saturate": SATURATE_VALUE,
+        }[spec.corrupt_mode]
+        w_stack = jnp.where(
+            crashed[:, None], jnp.asarray(bad, w_stack.dtype), w_stack
+        )
+        n_corrupt = jnp.sum(crashed).astype(jnp.float32)
+
+    if spec.fade_floor > 0.0 or spec.csi_std > 0.0:
+        h_r, h_i = channel.rayleigh_fade(k_fade, k)
+        h_sq = h_r**2 + h_i**2
+        if spec.csi_std > 0.0:
+            k_recover, k_degrade = jax.random.split(k_ge)
+            ge_bad = jnp.where(
+                ge_bad,
+                ~jax.random.bernoulli(k_recover, spec.ge_p_bg, (k,)),
+                jax.random.bernoulli(k_degrade, spec.ge_p_gb, (k,)),
+            )
+            std = spec.csi_std * jnp.where(ge_bad, spec.ge_bad_mult, 1.0)
+            scale = channel.csi_error_scale(k_csi, k, std)
+            w_stack = w_stack * scale[:, None].astype(w_stack.dtype)
+        if spec.fade_floor > 0.0:
+            erased = channel.deep_fade_mask(h_sq, spec.fade_floor)
+            w_stack = jnp.where(
+                erased[:, None], jnp.asarray(jnp.nan, w_stack.dtype), w_stack
+            )
+            n_erased = jnp.sum(erased).astype(jnp.float32)
+
+    return w_stack, ge_bad, n_erased, n_corrupt

@@ -28,6 +28,7 @@ or rely on the standard cluster env detection (TPU pods, GKE) by calling
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
@@ -40,6 +41,9 @@ def initialize(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     local_device_ids: Optional[list] = None,
+    max_retries: int = 3,
+    backoff_s: float = 1.0,
+    timeout_s: Optional[float] = None,
 ) -> None:
     """Bring up the cross-host runtime (idempotent).
 
@@ -47,6 +51,14 @@ def initialize(
     Slurm); explicit values cover manual launches.  After this returns,
     ``jax.devices()`` is the GLOBAL device list and meshes built from it span
     all hosts.
+
+    A pod bring-up is the single flakiest moment of a multi-host run — the
+    coordinator may simply not be listening yet when a worker process comes
+    up.  Connection attempts are therefore bounded-retried with exponential
+    backoff (``max_retries`` retries, ``backoff_s * 2**attempt`` sleeps,
+    ``timeout_s`` per-attempt connect timeout).  On exhaustion the LAST error
+    propagates and ``is_initialized()`` stays False — a later call may retry
+    cleanly rather than seeing a half-up state.
     """
     global _initialized
     if _initialized:
@@ -60,8 +72,26 @@ def initialize(
         kwargs["process_id"] = process_id
     if local_device_ids is not None:
         kwargs["local_device_ids"] = local_device_ids
-    jax.distributed.initialize(**kwargs)
-    _initialized = True
+    if timeout_s is not None:
+        # jax's per-attempt connect timeout knob (seconds)
+        kwargs["initialization_timeout"] = int(timeout_s)
+    last_err: Optional[BaseException] = None
+    for attempt in range(max_retries + 1):
+        if attempt:
+            time.sleep(backoff_s * 2 ** (attempt - 1))
+        try:
+            jax.distributed.initialize(**kwargs)
+            _initialized = True
+            return
+        except (RuntimeError, ConnectionError, TimeoutError, OSError) as e:
+            last_err = e
+    raise RuntimeError(
+        f"jax.distributed.initialize failed after {max_retries + 1} attempts"
+    ) from last_err
+
+
+def is_initialized() -> bool:
+    return _initialized
 
 
 def is_distributed() -> bool:

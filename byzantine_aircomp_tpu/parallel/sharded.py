@@ -99,6 +99,17 @@ class ShardedFedTrainer(FedTrainer):
                 self.client_m,
                 mesh_lib.sharding(self.mesh, mesh_lib.stack_spec()),
             )
+        if self.fault is not None:
+            # fault carry: the [K, d] stale-update buffer follows the
+            # client-stack layout; the [K] Gilbert-Elliott state replicates
+            stale, ge_bad = self.fault_state
+            if not isinstance(stale, tuple):
+                stale = jax.device_put(
+                    stale, mesh_lib.sharding(self.mesh, mesh_lib.stack_spec())
+                )
+            if not isinstance(ge_bad, tuple):
+                ge_bad = jax.device_put(ge_bad, repl)
+            self.fault_state = (stale, ge_bad)
         # server-opt state: [d]-shaped leaves follow the params layout,
         # scalars (e.g. adam's count) replicate
         self.server_opt_state = jax.tree.map(

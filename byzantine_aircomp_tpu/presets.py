@@ -117,6 +117,22 @@ PRESETS: Dict[str, dict] = {
         bucket_size=2,
         eval_train=False,
     ),
+    # robustness config: the imperfect-world stress test — adversarial
+    # clients (classflip) COMPOSED with every non-adversarial fault axis
+    # (dropout replay, deep-fade erasure, correlated CSI error, NaN
+    # corruption) against gm2, the paper's headline defense.  The run must
+    # stay finite every round (receiver finite-guard) and the per-round
+    # effective-K path shows how many clients actually landed
+    "chaos": dict(
+        dataset="mnist_hard",
+        model="MLP",
+        honest_size=16,
+        byz_size=4,
+        attack="classflip",
+        agg="gm2",
+        fault="chaos",
+        eval_train=False,
+    ),
     # scale-up config 5: CIFAR-10 ResNet-18 at K=1000 (multi-chip regime)
     "cifar10_resnet18_k1000_b100_signflip_krum": dict(
         dataset="cifar10",

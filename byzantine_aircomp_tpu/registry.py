@@ -52,6 +52,7 @@ class Registry:
 
 AGGREGATORS = Registry("aggregator")
 ATTACKS = Registry("attack")
+FAULTS = Registry("fault")
 MODELS = Registry("model")
 DATASETS = Registry("dataset")
 OPTIMIZERS = Registry("optimizer")

@@ -1,0 +1,42 @@
+"""Crash-safe file writes.
+
+Every artifact this framework persists (checkpoints, pickled metric
+records, sweep grids) must survive an interrupted process: a run killed
+mid-write must never leave a TRUNCATED file under the final name, because a
+later resume/analysis pass would load garbage.  The standard POSIX recipe —
+write a temp file in the destination directory, then ``os.replace`` (atomic
+on the same filesystem) — is centralized here so every writer shares one
+audited implementation.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Callable
+
+
+def atomic_write(path: str, write_fn: Callable, mode: str = "wb") -> str:
+    """Write ``path`` atomically: ``write_fn(file_obj)`` runs against a temp
+    file in the same directory, which is renamed over ``path`` only after
+    the write completes (and the ``os.fdopen`` context has flushed/closed).
+    On ANY failure the temp file is removed and the previous ``path``
+    content — if any — is left untouched."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, mode) as f:
+            write_fn(f)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return path
+
+
+def atomic_pickle(path: str, obj: Any) -> str:
+    """Atomically pickle ``obj`` to ``path``."""
+    return atomic_write(path, lambda f: pickle.dump(obj, f))

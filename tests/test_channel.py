@@ -77,3 +77,49 @@ def test_rayleigh_fade_moments():
     # each component ~ N(0, 1/2)
     np.testing.assert_allclose(h.var(), 0.5, rtol=0.05)
     assert abs(h.mean()) < 0.01
+
+
+def test_oma_deep_fade_floor_keeps_residual_finite(monkeypatch):
+    # regression: an exact-zero fade (the deep-fade limit) used to divide
+    # the equalization residual by |h|^2 = 0 -> Inf/NaN across the whole
+    # stack; the HSQ_FLOOR clamp keeps it finite
+    monkeypatch.setattr(
+        channel,
+        "rayleigh_fade",
+        lambda key, k: (jnp.zeros((k,), jnp.float32),) * 2,
+    )
+    out = np.asarray(channel.oma(jax.random.PRNGKey(8), jnp.ones((4, 8)), 1e-2))
+    assert np.isfinite(out).all()
+
+
+def test_oma2_deep_fade_floor_keeps_power_control_finite(monkeypatch):
+    # same limit on the AirComp sum: zero fade under a zero message made
+    # p_message 0/0 = NaN before the floor
+    monkeypatch.setattr(
+        channel,
+        "rayleigh_fade",
+        lambda key, k: (jnp.zeros((k,), jnp.float32),) * 2,
+    )
+    out = np.asarray(
+        channel.oma2(jax.random.PRNGKey(9), jnp.zeros((4, 8)), noise_var=None)
+    )
+    assert np.isfinite(out).all()
+
+
+def test_deep_fade_mask():
+    h_sq = jnp.array([0.01, 0.5, 0.04, 2.0])
+    mask = np.asarray(channel.deep_fade_mask(h_sq, 0.05))
+    np.testing.assert_array_equal(mask, [True, False, True, False])
+
+
+def test_csi_error_scale_statistics():
+    # exp(-eps) with eps ~ N(0, s): log of the scale has std s
+    keys = jax.random.split(jax.random.PRNGKey(10), 32)
+    scales = np.concatenate(
+        [np.asarray(channel.csi_error_scale(k, 256, 0.2)) for k in keys]
+    )
+    assert (scales > 0).all()
+    np.testing.assert_allclose(np.log(scales).std(), 0.2, rtol=0.1)
+    # zero std = perfect CSI = exact identity
+    ones = np.asarray(channel.csi_error_scale(keys[0], 16, 0.0))
+    np.testing.assert_array_equal(ones, np.ones(16, np.float32))

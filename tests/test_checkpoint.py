@@ -100,3 +100,84 @@ def test_resume_matches_uninterrupted_with_client_momentum(tmp_path):
     np.testing.assert_allclose(
         full["valLossPath"][-1], resumed["valLossPath"][-1], atol=1e-6
     )
+
+
+def test_checkpoint_midwrite_failure_preserves_previous(tmp_path, monkeypatch):
+    """A crash mid-write must never leave a truncated checkpoint under the
+    final name: the previous round's file survives and no temp litters."""
+    import os
+
+    import pytest
+
+    flat_a = np.arange(8.0, dtype=np.float32)
+    checkpoint.save(str(tmp_path), "t", 1, flat_a)
+
+    def die_midwrite(f, **kw):
+        f.write(b"partial garbage")
+        raise OSError("disk died mid-write")
+
+    monkeypatch.setattr(checkpoint.np, "savez", die_midwrite)
+    with pytest.raises(OSError):
+        checkpoint.save(str(tmp_path), "t", 2, 2 * flat_a)
+    monkeypatch.undo()
+
+    r, loaded, _ = checkpoint.load(str(tmp_path), "t")
+    assert r == 1
+    np.testing.assert_array_equal(loaded, flat_a)
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+
+
+def test_atomic_pickle_midwrite_failure_preserves_previous(tmp_path):
+    import os
+    import pickle
+
+    import pytest
+
+    from byzantine_aircomp_tpu.utils import io as io_lib
+
+    path = str(tmp_path / "record.pkl")
+    io_lib.atomic_pickle(path, {"round": 1})
+
+    class Dies:
+        def __reduce__(self):
+            raise RuntimeError("unpicklable mid-stream")
+
+    with pytest.raises(RuntimeError):
+        io_lib.atomic_pickle(path, {"round": 2, "poison": Dies()})
+
+    with open(path, "rb") as f:
+        assert pickle.load(f) == {"round": 1}
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+
+
+def test_resume_matches_uninterrupted_with_fault_state(tmp_path):
+    # the fault carry (stale-update buffer + Gilbert-Elliott channel state)
+    # is part of the resumable state: a resume that dropped it would replay
+    # wrong stale updates and diverge from the uninterrupted trajectory
+    import byzantine_aircomp_tpu.data.datasets as dl
+    from byzantine_aircomp_tpu.fed import harness
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+
+    def cfg(rounds):
+        return FedConfig(
+            honest_size=6, rounds=rounds, display_interval=3, batch_size=16,
+            agg="gm2", eval_train=False, fault="chaos", dropout_prob=0.4,
+            checkpoint_dir=str(tmp_path) + "/", cache_dir=str(tmp_path) + "/c/",
+        )
+
+    orig = dl.load
+    dl.load = lambda name, **kw: orig(name, synthetic_train=1500, synthetic_val=300)
+    try:
+        full = harness.run(cfg(4), record_in_file=False)
+        harness.run(cfg(2), record_in_file=False)
+        resumed = harness.run(
+            FedConfig(**{**cfg(4).__dict__, "inherit": True}),
+            record_in_file=False,
+        )
+    finally:
+        dl.load = orig
+    np.testing.assert_allclose(
+        full["valLossPath"][-1], resumed["valLossPath"][-1], atol=1e-6
+    )
+    # a resumed run records only the rounds it actually ran (2 -> 4)
+    assert len(resumed["effectiveKPath"]) == 2

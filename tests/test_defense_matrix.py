@@ -91,3 +91,40 @@ def test_every_aggregator_survives_an_overflowed_row(agg_name):
     )
     assert out.shape == (D,)
     assert np.isfinite(out).all(), f"{agg_name} leaked the overflowed row"
+
+
+@pytest.mark.parametrize("n_dead", [1, 3])
+@pytest.mark.parametrize("agg_name", sorted(AGGREGATORS.names()))
+def test_every_aggregator_survives_nan_clients_degraded(agg_name, n_dead):
+    # the fault-injection contract (docs/DESIGN.md "Fault model"): with
+    # ``degraded=True`` EVERY registered aggregator — mean included, since
+    # a crashed client is a fault the receiver must shrug off, not an
+    # adversary mean is entitled to average in — yields a finite aggregate
+    # from a stack with NaN-poisoned rows, as long as finite rows remain
+    if agg_name == "Krum":
+        pytest.skip("alias")
+    w, guess = _stack()
+    for i in range(n_dead):
+        w = w.at[K - 1 - i].set(jnp.nan)
+    fn = agg_lib.resolve(agg_name)
+    out = np.asarray(
+        fn(
+            w,
+            honest_size=HONEST,
+            key=jax.random.PRNGKey(5),
+            noise_var=None,
+            guess=guess,
+            maxiter=50,
+            tol=1e-5,
+            impl="xla",
+            m=None,
+            clip_tau=None,
+            clip_iters=3,
+            sign_eta=None,
+            degraded=True,
+        )
+    )
+    assert out.shape == (D,)
+    assert np.isfinite(out).all(), (
+        f"{agg_name} (degraded) leaked {n_dead} NaN client(s)"
+    )

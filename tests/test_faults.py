@@ -1,0 +1,435 @@
+"""Fault-injection subsystem: spec resolution, the per-round transforms,
+graceful aggregator degradation, and the end-to-end survival contract
+(ISSUE acceptance: gm2 under dropout + a NaN-corrupting client stays finite
+every round with effective-K recorded).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu.data import datasets as data_lib
+from byzantine_aircomp_tpu.fed.config import FedConfig
+from byzantine_aircomp_tpu.fed.train import FedTrainer
+from byzantine_aircomp_tpu.ops import aggregators as agg_lib
+from byzantine_aircomp_tpu.ops import faults as fault_lib
+from byzantine_aircomp_tpu.registry import FAULTS
+
+K, D = 12, 16
+
+
+def _stack(key=0):
+    return 0.1 * jax.random.normal(jax.random.PRNGKey(key), (K, D))
+
+
+# ----------------------------------------------------------------------
+# spec resolution / validation
+
+
+def test_registered_faults_resolve_and_validate():
+    for name in FAULTS.names():
+        spec = fault_lib.resolve(name)
+        assert spec.validate() is spec
+
+
+def test_resolve_none_is_ideal():
+    assert fault_lib.resolve(None) is None
+
+
+def test_resolve_none_rejects_overrides():
+    with pytest.raises(AssertionError):
+        fault_lib.resolve(None, {"dropout_prob": 0.5})
+
+
+def test_resolve_applies_overrides():
+    spec = fault_lib.resolve("dropout", {"dropout_prob": 0.7})
+    assert spec.dropout_prob == 0.7
+
+
+def test_invalid_specs_rejected():
+    with pytest.raises(AssertionError):
+        fault_lib.resolve("dropout", {"dropout_prob": 1.5})
+    with pytest.raises(AssertionError):
+        fault_lib.resolve("corrupt", {"corrupt_mode": "zeros"})
+    with pytest.raises(AssertionError):
+        # corruption enabled but no eligible clients
+        fault_lib.resolve("corrupt", {"corrupt_size": 0})
+
+
+def test_config_fault_knobs_require_fault():
+    with pytest.raises(AssertionError):
+        FedConfig(honest_size=4, dropout_prob=0.5).validate()
+
+
+def test_config_fault_requires_full_participation():
+    with pytest.raises(AssertionError):
+        FedConfig(
+            honest_size=4, fault="dropout", participation=0.5
+        ).validate()
+
+
+# ----------------------------------------------------------------------
+# dropout / stale replay
+
+
+def test_dropout_certain_replays_stale():
+    spec = fault_lib.FaultSpec("t", dropout_prob=1.0).validate()
+    w = _stack()
+    init = jnp.zeros((D,))
+    stale, _ = fault_lib.init_state(spec, K, init)
+    delivered, new_stale, n = fault_lib.apply_dropout(
+        spec, jax.random.PRNGKey(1), w, stale
+    )
+    # every client dropped: the round delivers the initial params
+    np.testing.assert_array_equal(np.asarray(delivered), np.zeros((K, D)))
+    assert float(n) == K
+    # ... and keeps replaying them next round
+    delivered2, _, _ = fault_lib.apply_dropout(
+        spec, jax.random.PRNGKey(2), w, new_stale
+    )
+    np.testing.assert_array_equal(np.asarray(delivered2), np.zeros((K, D)))
+
+
+def test_dropout_off_is_identity():
+    spec = fault_lib.FaultSpec("t", fade_floor=0.05).validate()
+    w = _stack()
+    delivered, stale, n = fault_lib.apply_dropout(
+        spec, jax.random.PRNGKey(1), w, ()
+    )
+    assert delivered is w and stale == () and float(n) == 0.0
+
+
+def test_dropout_buffer_advances_for_delivering_clients():
+    spec = fault_lib.FaultSpec("t", dropout_prob=0.5).validate()
+    w = _stack()
+    stale, _ = fault_lib.init_state(spec, K, jnp.zeros((D,)))
+    delivered, new_stale, n = fault_lib.apply_dropout(
+        spec, jax.random.PRNGKey(3), w, stale
+    )
+    d, w_np = np.asarray(delivered), np.asarray(w)
+    # each row is either this round's update or the stale (zero) one,
+    # and the buffer equals exactly what was delivered
+    assert all(
+        (row == 0).all() or (row == w_np[i]).all() for i, row in enumerate(d)
+    )
+    np.testing.assert_array_equal(np.asarray(new_stale), d)
+    assert 0 < float(n) < K  # p=0.5 at K=12, both outcomes present
+
+
+# ----------------------------------------------------------------------
+# transmission impairments
+
+
+@pytest.mark.parametrize(
+    "mode,check",
+    [
+        ("nan", lambda rows: np.isnan(rows).all()),
+        ("inf", lambda rows: np.isinf(rows).all()),
+        (
+            "saturate",
+            lambda rows: (rows == fault_lib.SATURATE_VALUE).all(),
+        ),
+    ],
+)
+def test_corruption_modes(mode, check):
+    spec = fault_lib.FaultSpec(
+        "t", corrupt_prob=1.0, corrupt_mode=mode, corrupt_size=2
+    ).validate()
+    w = _stack()
+    out, _, n_erased, n_corrupt = fault_lib.apply_transmission(
+        spec, jax.random.PRNGKey(1), w, ()
+    )
+    out = np.asarray(out)
+    assert check(out[:2])  # only the first corrupt_size rows are eligible
+    np.testing.assert_array_equal(out[2:], np.asarray(w)[2:])
+    assert float(n_corrupt) == 2 and float(n_erased) == 0.0
+
+
+def test_deep_fade_erases_rows():
+    # a floor above any plausible |h|^2 puts every client in outage
+    spec = fault_lib.FaultSpec("t", fade_floor=1e9).validate()
+    w = _stack()
+    out, _, n_erased, n_corrupt = fault_lib.apply_transmission(
+        spec, jax.random.PRNGKey(1), w, ()
+    )
+    assert np.isnan(np.asarray(out)).all()
+    assert float(n_erased) == K and float(n_corrupt) == 0.0
+
+
+def test_csi_error_scales_rows():
+    spec = fault_lib.FaultSpec("t", csi_std=0.3).validate()
+    w = _stack()
+    _, ge_bad = fault_lib.init_state(spec, K, jnp.zeros((D,)))
+    out, _, _, _ = fault_lib.apply_transmission(
+        spec, jax.random.PRNGKey(1), w, ge_bad
+    )
+    # each row is the original times one positive per-client scalar
+    ratio = np.asarray(out) / np.asarray(w)
+    assert np.isfinite(ratio).all() and (ratio > 0).all()
+    np.testing.assert_allclose(
+        ratio, ratio[:, :1] * np.ones((1, D)), rtol=1e-5
+    )
+    assert not np.allclose(ratio[:, 0], 1.0)
+
+
+def test_gilbert_elliott_transitions():
+    spec = fault_lib.FaultSpec(
+        "t", csi_std=0.1, ge_p_gb=1.0, ge_p_bg=0.0
+    ).validate()
+    _, ge_bad = fault_lib.init_state(spec, K, jnp.zeros((D,)))
+    assert not np.asarray(ge_bad).any()  # all start good
+    w = _stack()
+    _, ge1, _, _ = fault_lib.apply_transmission(
+        spec, jax.random.PRNGKey(1), w, ge_bad
+    )
+    assert np.asarray(ge1).all()  # P(good->bad)=1: all bad after one round
+    _, ge2, _, _ = fault_lib.apply_transmission(
+        spec, jax.random.PRNGKey(2), w, ge1
+    )
+    assert np.asarray(ge2).all()  # P(bad->good)=0: absorbed
+
+
+# ----------------------------------------------------------------------
+# graceful degradation: the degraded rules match the plain rules applied
+# to the stack with the dead rows REMOVED (the gold-standard semantics a
+# dynamic-K implementation must reproduce with static shapes)
+
+
+def _poisoned():
+    w = _stack()
+    w = w.at[1].set(jnp.nan).at[4].set(jnp.inf)
+    clean = jnp.concatenate([w[:1], w[2:4], w[5:]], axis=0)
+    return w, clean
+
+
+def test_degraded_mean_matches_clean_subset():
+    w, clean = _poisoned()
+    np.testing.assert_allclose(
+        np.asarray(agg_lib.mean(w, degraded=True)),
+        np.asarray(agg_lib.mean(clean)),
+        rtol=1e-6,
+    )
+
+
+def test_degraded_median_matches_clean_subset():
+    w, clean = _poisoned()
+    np.testing.assert_allclose(
+        np.asarray(agg_lib.median(w, degraded=True)),
+        np.asarray(agg_lib.median(clean)),
+        rtol=1e-6,
+    )
+
+
+def test_degraded_trimmed_mean_matches_clean_subset():
+    w, clean = _poisoned()
+    np.testing.assert_allclose(
+        np.asarray(agg_lib.trimmed_mean(w, degraded=True)),
+        np.asarray(agg_lib.trimmed_mean(clean)),
+        rtol=1e-6,
+    )
+
+
+def test_degraded_krum_matches_clean_subset():
+    # with n >= honest_size the adaptive neighbor budget equals the static
+    # one, so degraded selection on the poisoned stack must pick the same
+    # vector plain Krum picks on the cleaned stack
+    w, clean = _poisoned()
+    np.testing.assert_allclose(
+        np.asarray(agg_lib.krum(w, honest_size=8, degraded=True)),
+        np.asarray(agg_lib.krum(clean, honest_size=8)),
+        rtol=1e-6,
+    )
+
+
+def test_degraded_multi_krum_matches_clean_subset():
+    w, clean = _poisoned()
+    np.testing.assert_allclose(
+        np.asarray(agg_lib.multi_krum(w, honest_size=8, degraded=True)),
+        np.asarray(agg_lib.multi_krum(clean, honest_size=8)),
+        rtol=1e-6,
+    )
+
+
+def test_degraded_krum_never_selects_dead_row():
+    # fewer finite rows than honest_size: the static rule would demand more
+    # neighbors than exist; the adaptive rule must still pick a finite row
+    w = _stack()
+    for i in range(K - 3):  # only 3 finite rows remain
+        w = w.at[i + 3].set(jnp.nan)
+    out = np.asarray(agg_lib.krum(w, honest_size=8, degraded=True))
+    assert np.isfinite(out).all()
+
+
+def test_degraded_all_dead_triggers_guard_convention():
+    # zero finite rows: every degraded rule must return a NON-finite vector
+    # (the trainer's receiver finite-guard then keeps the previous params)
+    w = jnp.full((K, D), jnp.nan)
+    for fn, kw in [
+        (agg_lib.mean, {}),
+        (agg_lib.median, {}),
+        (agg_lib.trimmed_mean, {}),
+        (agg_lib.multi_krum, {"honest_size": 8}),
+        (agg_lib.bulyan, {"honest_size": 10}),
+    ]:
+        out = np.asarray(fn(w, degraded=True, **kw))
+        assert not np.isfinite(out).all(), fn.__name__
+
+
+# ----------------------------------------------------------------------
+# end-to-end: the ISSUE acceptance contract
+
+
+def _tiny_ds():
+    return data_lib.load("mnist", synthetic_train=1000, synthetic_val=200)
+
+
+def test_gm2_survives_dropout_plus_nan_corruption():
+    """gm2 under 20% dropout + one NaN-corrupting client: finite params
+    every round, per-round effective-K metrics recorded."""
+    cfg = FedConfig(
+        honest_size=8,
+        byz_size=2,
+        attack="classflip",
+        agg="gm2",
+        rounds=3,
+        display_interval=3,
+        batch_size=32,
+        eval_train=False,
+        fault="dropout",
+        dropout_prob=0.2,
+        corrupt_prob=1.0,
+        corrupt_mode="nan",
+        corrupt_size=1,
+    )
+    tr = FedTrainer(cfg, dataset=_tiny_ds())
+    for r in range(cfg.rounds):
+        tr.run_round(r)
+        assert np.isfinite(np.asarray(tr.flat_params)).all(), f"round {r}"
+        dropped, erased, corrupt, eff_k = (
+            float(v) for v in np.asarray(tr.last_fault_metrics)
+        )
+        assert 0 < eff_k <= cfg.node_size
+        assert corrupt >= 1.0  # p=1: the faulty client crashed every iter
+
+
+def test_fault_paths_recorded_in_train():
+    cfg = FedConfig(
+        honest_size=6,
+        rounds=2,
+        display_interval=2,
+        batch_size=32,
+        agg="mean",
+        eval_train=False,
+        fault="dropout",
+        dropout_prob=0.3,
+    )
+    paths = FedTrainer(cfg, dataset=_tiny_ds()).train()
+    for key in (
+        "faultDroppedPath",
+        "faultErasedPath",
+        "faultCorruptPath",
+        "effectiveKPath",
+    ):
+        assert len(paths[key]) == cfg.rounds
+    assert all(0 < k <= cfg.node_size for k in paths["effectiveKPath"])
+
+
+def test_no_fault_run_has_no_fault_paths():
+    cfg = FedConfig(
+        honest_size=6,
+        rounds=1,
+        display_interval=2,
+        batch_size=32,
+        agg="mean",
+        eval_train=False,
+    )
+    paths = FedTrainer(cfg, dataset=_tiny_ds()).train()
+    assert "effectiveKPath" not in paths
+
+
+def test_fault_run_deterministic_given_seed():
+    def run():
+        cfg = FedConfig(
+            honest_size=6,
+            rounds=2,
+            display_interval=2,
+            batch_size=32,
+            agg="gm2",
+            eval_train=False,
+            fault="chaos",
+            seed=7,
+        )
+        tr = FedTrainer(cfg, dataset=_tiny_ds())
+        tr.train()
+        return np.asarray(tr.flat_params)
+
+    np.testing.assert_array_equal(run(), run())
+
+
+def test_chaos_preset_builds():
+    from byzantine_aircomp_tpu import presets
+
+    cfg = presets.get("chaos", rounds=1)
+    cfg.validate()
+    assert cfg.fault == "chaos" and cfg.agg == "gm2"
+
+
+def test_cli_fault_flags():
+    from byzantine_aircomp_tpu.cli import build_parser, config_from_args
+
+    argv = ["--fault", "chaos", "--dropout-prob", "0.3", "--agg", "gm2"]
+    cfg = config_from_args(build_parser().parse_args(argv), argv)
+    assert cfg.fault == "chaos" and cfg.dropout_prob == 0.3
+    cfg.validate()
+
+
+def test_run_title_fault_suffix():
+    from byzantine_aircomp_tpu.fed.harness import run_title
+
+    plain = run_title(FedConfig(honest_size=6))
+    faulty = run_title(
+        FedConfig(honest_size=6, fault="chaos", dropout_prob=0.3)
+    )
+    assert plain != faulty
+    assert "faultchaos" in faulty and "dropoutprob0.3" in faulty
+
+
+def test_ref_backend_rejects_faults():
+    from byzantine_aircomp_tpu.backends.ref_trainer import run_ref
+
+    with pytest.raises(NotImplementedError):
+        run_ref(FedConfig(honest_size=4, rounds=1, fault="dropout"))
+
+
+# ----------------------------------------------------------------------
+# the full survival matrix (slow tier); the fast smoke above covers the
+# acceptance cell
+
+
+@pytest.mark.slow
+def test_fault_matrix_sweep():
+    from byzantine_aircomp_tpu.analysis import fault_matrix
+
+    grid = fault_matrix.run_matrix(
+        ["gm2", "mean"],
+        [None, "dropout", "chaos"],
+        [None, "classflip"],
+        dict(
+            honest_size=8,
+            byz_size=2,
+            rounds=2,
+            display_interval=2,
+            batch_size=32,
+            eval_train=False,
+        ),
+        dataset=_tiny_ds(),
+        log=lambda s: None,
+    )
+    assert len(grid) == 12
+    for (agg, fault, attack), cell in grid.items():
+        assert cell["finite_all_rounds"], (agg, fault, attack)
+        if fault is not None:
+            assert 0 < cell["min_effective_k"] <= 10
+    table = fault_matrix.markdown_table(grid)
+    assert "chaos" in table and "gm2" in table

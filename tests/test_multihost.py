@@ -157,3 +157,46 @@ def test_multi_process_sharded_round(tmp_path, nprocs, agg):
     assert abs(float(l_str) - l_ref) < 5e-4 and abs(float(a_str) - a_ref) < 5e-3, (
         f"multi-host != single-process: {results[0]} vs {l_ref:.8f} {a_ref:.6f}"
     )
+
+
+def test_initialize_retries_with_backoff(monkeypatch):
+    """Satellite contract: a flaky coordinator is retried with exponential
+    backoff; on exhaustion the runtime stays un-initialized (no half-up
+    state) and a later call may retry cleanly."""
+    from byzantine_aircomp_tpu.parallel import multihost
+
+    monkeypatch.setattr(multihost, "_initialized", False)
+    calls = []
+    sleeps = []
+    monkeypatch.setattr(multihost.time, "sleep", lambda s: sleeps.append(s))
+
+    def always_down(**kw):
+        calls.append(kw)
+        raise RuntimeError("coordinator unreachable")
+
+    monkeypatch.setattr(multihost.jax.distributed, "initialize", always_down)
+    with pytest.raises(RuntimeError, match="after 3 attempts"):
+        multihost.initialize(
+            coordinator="localhost:1", max_retries=2, backoff_s=0.5,
+            timeout_s=7,
+        )
+    assert len(calls) == 3
+    assert sleeps == [0.5, 1.0]  # backoff_s * 2**attempt
+    assert calls[0]["initialization_timeout"] == 7  # connect timeout passed
+    assert not multihost.is_initialized()
+
+    # transient failure: second attempt succeeds, state flips to up
+    calls.clear()
+
+    def down_then_up(**kw):
+        calls.append(kw)
+        if len(calls) < 2:
+            raise ConnectionError("refused")
+
+    monkeypatch.setattr(multihost.jax.distributed, "initialize", down_then_up)
+    multihost.initialize(coordinator="localhost:1", backoff_s=0.0)
+    assert len(calls) == 2
+    assert multihost.is_initialized()
+    # idempotent: a re-call is a no-op, not a reconnect
+    multihost.initialize(coordinator="localhost:1")
+    assert len(calls) == 2

@@ -148,7 +148,18 @@ def test_sweep_forwards_every_shared_knob():
         "dnc_iters": 2,
         "dnc_sub_dim": 64,
         "dnc_c": 0.5,
+        "fault": "chaos",
+        "dropout_prob": 0.15,
+        "fade_floor": 1e-3,
+        "csi_std": 0.1,
+        "corrupt_prob": 0.02,
+        "corrupt_mode": "saturate",
+        "corrupt_size": 1,
     }
+    # the fault knobs require --fault and full participation
+    # (config.validate), so they ride a second, separate sweep cell
+    fault_dests = {"fault", "dropout_prob", "fade_floor", "csi_std",
+                   "corrupt_prob", "corrupt_mode", "corrupt_size"}
     probe = argparse.ArgumentParser()
     add_knob_flags(probe)
     flag_of = {
@@ -162,23 +173,25 @@ def test_sweep_forwards_every_shared_knob():
         "here so their cfg_kw forwarding is covered"
     )
 
-    argv = ["--aggs", "mean", "--attacks", "none", "--K", "8", "--B", "0",
+    base = ["--aggs", "mean", "--attacks", "none", "--K", "8", "--B", "0",
             "--rounds", "1", "--interval", "2", "--batch-size", "8"]
-    for dest, flag in flag_of.items():
-        argv += [flag, str(samples[dest])]
-
-    captured = {}
     orig = sweep_mod.run_sweep
+    for group in (set(flag_of) - fault_dests, fault_dests):
+        argv = list(base)
+        for dest in sorted(group):
+            argv += [flag_of[dest], str(samples[dest])]
 
-    def spy(aggs, attacks, cfg_kw, **kw):
-        captured.update(cfg_kw)
-        return orig(aggs, attacks, cfg_kw, **kw)
+        captured = {}
 
-    sweep_mod.run_sweep = spy
-    try:
-        sweep_mod.main(argv)
-    finally:
-        sweep_mod.run_sweep = orig
-    for dest in flag_of:
-        assert captured.get(dest) == samples[dest], (
-            dest, captured.get(dest))
+        def spy(aggs, attacks, cfg_kw, **kw):
+            captured.update(cfg_kw)
+            return orig(aggs, attacks, cfg_kw, **kw)
+
+        sweep_mod.run_sweep = spy
+        try:
+            sweep_mod.main(argv)
+        finally:
+            sweep_mod.run_sweep = orig
+        for dest in group:
+            assert captured.get(dest) == samples[dest], (
+                dest, captured.get(dest))
