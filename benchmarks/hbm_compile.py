@@ -20,7 +20,10 @@ from __future__ import annotations
 import argparse
 import json
 
-from trajectory import _coerce  # same --set plumbing
+# same --set plumbing as trajectory.py, from the package (the old
+# ``from trajectory import _coerce`` only worked when this directory
+# happened to lead sys.path — i.e. plain-script runs, not -m or pytest)
+from byzantine_aircomp_tpu.fed.config import coerce_field as _coerce
 
 
 def main(argv=None) -> int:

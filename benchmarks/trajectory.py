@@ -34,28 +34,14 @@ import argparse
 import json
 import sys
 import time
-import typing
 
-from byzantine_aircomp_tpu.fed.config import FedConfig
+from byzantine_aircomp_tpu.fed.config import FedConfig, coerce_field
 from byzantine_aircomp_tpu.fed.train import FedTrainer
 
-_FIELD_TYPES = typing.get_type_hints(FedConfig)
-
-
-def _coerce(name: str, raw: str):
-    """Coerce a key=value string by the FedConfig field's annotation."""
-    if name not in _FIELD_TYPES:
-        raise SystemExit(f"unknown FedConfig field {name!r}")
-    tp = _FIELD_TYPES[name]
-    origin = typing.get_origin(tp)
-    if origin is typing.Union:  # Optional[...]
-        args = [a for a in typing.get_args(tp) if a is not type(None)]
-        if raw.lower() in ("none", "null"):
-            return None
-        tp = args[0]
-    if tp is bool:
-        return raw.lower() in ("1", "true", "yes")
-    return tp(raw)
+# the --set plumbing lives in the package now (fed/config.py::coerce_field)
+# so benchmarks/hbm_compile.py can import it without sys.path games; the
+# old name stays as an alias for anything that imported it from here
+_coerce = coerce_field
 
 
 def main(argv=None) -> int:
@@ -116,12 +102,14 @@ def main(argv=None) -> int:
                 "use the CLI harness --checkpoint-dir/--inherit for "
                 "server-opt or client-momentum runs"
             )
-        # config-derived title so differently-configured cells sharing one
-        # checkpoint dir can never silently resume each other's state
-        # (the exact hazard fed/harness.py::run_title exists to prevent)
-        from byzantine_aircomp_tpu.fed.harness import run_title
+        # config-derived title + full-config hash: run_title alone omits
+        # seed/sizes/dataset/batch/gamma/widths, so differently-configured
+        # cells sharing one checkpoint dir COULD silently resume each
+        # other's state (e.g. seed-2021 vs seed-2022 ResNet cells both
+        # titled ResNet18_SGD_gradascent_krum); the hash suffix closes that
+        from byzantine_aircomp_tpu.fed.harness import ckpt_title as _ckpt
 
-        ckpt_title = run_title(cfg)
+        ckpt_title = _ckpt(cfg)
         restored = ckpt_lib.load(args.checkpoint_dir, ckpt_title)
         if restored is not None:
             start_round, flat, _ = restored

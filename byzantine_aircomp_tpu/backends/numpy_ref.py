@@ -43,12 +43,23 @@ def _krum_scores(w: np.ndarray, honest_size: int) -> np.ndarray:
     # non-finite row is +Inf (never selected) and the diagonal is 0.
     # "poisoned" = non-finite entries OR an f32-overflowing squared norm
     # (finite ~1e20 entries overflow ||w||^2 to Inf and behave exactly like
-    # an Inf row in the JAX path's f32 Gram form) — the f64 norms computed
-    # here never overflow for f32 inputs, so the thresholds are exact
-    f32max = float(np.finfo(np.float32).max)
+    # an Inf row in the JAX path's f32 Gram form).  Overflow is judged by
+    # ROUNDING the f64 sum to f32 (round-to-nearest-even, like the JAX
+    # path's f32 accumulate) rather than a raw ``> f32max`` compare: f64
+    # values in (f32max, f32max * (1 + 2^-25)] round DOWN to f32max — a
+    # strict threshold test would call them overflowed when f32 arithmetic
+    # keeps them finite.  Caveat: within a few ULP of the boundary the two
+    # backends can still legitimately disagree — f32 accumulation ORDER in
+    # the JAX reduce may overflow (or not) where the correctly-rounded f64
+    # sum lands on the other side; exact parity there is unattainable.
     finite = np.isfinite(w).all(axis=1)
     sq64 = (w.astype(np.float64) ** 2).sum(axis=1)
-    bad = ~finite | (sq64 > f32max)
+
+    def _f32_overflows(x64: np.ndarray) -> np.ndarray:
+        with np.errstate(over="ignore"):
+            return np.isinf(x64.astype(np.float32))
+
+    bad = ~finite | _f32_overflows(sq64)
     wz = np.where(~bad[:, None], w, 0.0).astype(np.float64)
     dist = ((wz[:, None, :] - wz[None, :, :]) ** 2).sum(axis=-1)
     # emulate the JAX path's f32 Gram-form overflow for rows that are NOT
@@ -59,9 +70,9 @@ def _krum_scores(w: np.ndarray, honest_size: int) -> np.ndarray:
     # broadcast form above would see 0 and let them win selection, which
     # the JAX path rejects — parity demands the f32 semantics).  By AM-GM
     # 2*|gram| <= sq_i + sq_j, so the sq-sum test covers the gram term.
-    pair_over = (sq64[:, None] + sq64[None, :]) > f32max
+    pair_over = _f32_overflows(sq64[:, None] + sq64[None, :])
     dist[pair_over] = np.inf
-    dist[dist > f32max] = np.inf  # f32 saturation of the distance itself
+    dist[_f32_overflows(dist)] = np.inf  # f32 saturation of the distance
     dist[bad, :] = np.inf
     dist[:, bad] = np.inf
     np.fill_diagonal(dist, 0.0)
@@ -77,7 +88,7 @@ def _krum_scores(w: np.ndarray, honest_size: int) -> np.ndarray:
     # colluding band the distances are huge-but-finite in f64 while the
     # JAX path's f32 top_k sum saturates to Inf — saturate to match, so
     # rejected rows rank identically (all Inf) in both backends
-    scores[scores > f32max] = np.inf
+    scores[_f32_overflows(scores)] = np.inf
     return scores
 
 

@@ -102,6 +102,7 @@ ARG_TO_FIELD = {
     "inherit": ("inherit", None),
     "sharding": ("sharded", _SHARDING.get),
     "agg_impl": ("agg_impl", None),
+    "fused_epilogue": ("fused_epilogue", None),
     "prng_impl": ("prng_impl", None),
     "stack_dtype": ("stack_dtype", None),
     "partition": ("partition", None),
@@ -186,6 +187,14 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["auto", "xla", "pallas"],
         default="auto",
         help="Weiszfeld step implementation (pallas = fused TPU kernel)",
+    )
+    p.add_argument(
+        "--fused-epilogue",
+        choices=["auto", "on", "off"],
+        default="auto",
+        help="single-HBM-pass sort-family aggregation epilogue "
+             "(median/trimmed_mean selection + in-read OMA channel; "
+             "auto = on for the pallas impl without faults)",
     )
     add_knob_flags(p)
     p.add_argument(

@@ -8,6 +8,7 @@ seed 2021, gm/gm2 maxiter 1000 tol 1e-5 (``:350``).
 
 from __future__ import annotations
 
+import typing
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -106,6 +107,15 @@ class FedConfig:
     # the sharded trainer forces xla on multi-device meshes (GSPMD
     # cannot partition pallas_call)
     agg_impl: str = "auto"
+    # "auto" | "on" | "off": single-HBM-pass aggregation epilogue for the
+    # sort-family aggregators (median / trimmed_mean) — selection (Pallas
+    # peel kernel on TPU, XLA key bisection elsewhere) instead of the full
+    # [K, d] sort, with the OMA channel prepass folded into the same stack
+    # read.  "auto" = on exactly when the resolved agg impl is pallas and
+    # no fault is injected; "on" forces the XLA selection realization on
+    # other backends too; degraded/bucketed/bf16 rounds always fall back
+    # to the sort path (docs/DESIGN.md fallback matrix)
+    fused_epilogue: str = "auto"
     # "f32" | "bf16": storage dtype of the [K, d] client stack handed to
     # the aggregator.  "bf16" halves the aggregator's HBM read traffic —
     # the Weiszfeld solvers re-read the whole stack every iteration, the
@@ -242,6 +252,10 @@ class FedConfig:
         assert self.agg_impl in ("auto", "xla", "pallas"), (
             f"agg_impl must be 'auto', 'xla' or 'pallas', got {self.agg_impl!r}"
         )
+        assert self.fused_epilogue in ("auto", "on", "off"), (
+            f"fused_epilogue must be 'auto', 'on' or 'off', "
+            f"got {self.fused_epilogue!r}"
+        )
         assert 0.0 < self.participation <= 1.0, (
             f"participation must be in (0, 1], got {self.participation}"
         )
@@ -359,3 +373,24 @@ class FedConfig:
                 f"crashed honest senders; Byzantine rows are the attack's)"
             )
         return self
+
+
+def coerce_field(name: str, raw: str):
+    """Coerce a ``key=value`` CLI string by the FedConfig field's annotation.
+
+    The ``--set`` plumbing shared by benchmarks/trajectory.py and
+    benchmarks/hbm_compile.py (it lived in trajectory.py, which forced
+    hbm_compile into a sys.path-dependent ``from trajectory import ...``).
+    Bools accept true/false/1/yes; Optional fields accept "none"/"null".
+    """
+    hints = typing.get_type_hints(FedConfig)
+    if name not in hints:
+        raise SystemExit(f"unknown FedConfig field {name!r}")
+    tp = hints[name]
+    if typing.get_origin(tp) is typing.Union:  # Optional[...]
+        if raw.lower() in ("none", "null"):
+            return None
+        tp = [a for a in typing.get_args(tp) if a is not type(None)][0]
+    if tp is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return tp(raw)

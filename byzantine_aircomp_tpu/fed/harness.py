@@ -113,6 +113,41 @@ def run_title(cfg: FedConfig) -> str:
     return title
 
 
+def config_hash(cfg: FedConfig) -> str:
+    """Short stable digest of EVERY result-affecting config field.
+
+    ``run_title`` spells out only the knobs the reference scheme (and our
+    non-default suffixes) name — seed, honest/byz sizes, dataset,
+    batch_size, gamma, widths and the rest of the dataclass never reach the
+    title, so e.g. seed-2021 and seed-2022 ResNet cells share
+    ``ResNet18_SGD_gradascent_krum`` and would silently resume each other's
+    checkpoints.  Hash the full field dict and let :func:`ckpt_title`
+    append it where collision actually corrupts results.  Excluded:
+    path-like fields (they relocate outputs without changing the
+    trajectory), ``inherit`` (the resume switch itself), and ``rounds`` —
+    the schedule horizon is exactly the knob ``--inherit`` is meant to
+    vary (a rounds=100 run continues a rounds=50 checkpoint; the per-round
+    trajectory prefix is identical by the fold_in key discipline).
+    """
+    import hashlib
+
+    skip = ("checkpoint_dir", "cache_dir", "profile_dir", "inherit", "rounds")
+    items = sorted(
+        (f.name, repr(getattr(cfg, f.name)))
+        for f in dataclasses.fields(cfg)
+        if f.name not in skip
+    )
+    return hashlib.sha256(repr(items).encode()).hexdigest()[:8]
+
+
+def ckpt_title(cfg: FedConfig) -> str:
+    """Checkpoint key: the human-readable run title plus the config hash,
+    so two configs can only share saved state when EVERY result-affecting
+    field matches.  Pickled metric records keep the bare ``run_title``
+    (reference-compatible paths for draw.ipynb-style analysis)."""
+    return f"{run_title(cfg)}_c{config_hash(cfg)}"
+
+
 def cache_path(cfg: FedConfig, dataset_name: str) -> str:
     cache_dir = cfg.cache_dir or f"./{dataset_name.upper()}_Air_weight_tpu/"
     os.makedirs(cache_dir, exist_ok=True)
@@ -202,7 +237,10 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
     # checkpoint / resume (the reference's --inherit was dead; :22,:500)
     start_round = 0
     checkpoint_fn = None
-    title = run_title(cfg)
+    # keyed on ckpt_title (run_title + config hash): run_title alone omits
+    # seed/sizes/dataset/gamma/widths, so distinct cells could silently
+    # resume each other's state from a shared checkpoint dir
+    title = ckpt_title(cfg)
     if cfg.checkpoint_dir:
         import jax
 

@@ -192,6 +192,19 @@ class FedTrainer:
         self._stack_dtype = (
             jnp.bfloat16 if cfg.stack_dtype == "bf16" else jnp.float32
         )
+        # fused aggregation epilogue (single-HBM-pass sort-family selection
+        # + in-read OMA; ops/aggregators.py dispatch).  "auto" enables it
+        # exactly where it is the proven win: the pallas impl on TPU with no
+        # fault injection (faults run degraded aggregators, which always
+        # fall back).  "on" forces it elsewhere too — off-TPU the epilogue
+        # resolves to the XLA key-bisection selection, which beats the full
+        # sort on CPU as well.  The sharded trainer forces this off before
+        # the first trace, like _agg_impl (see parallel/sharded.py).
+        self._fused_epilogue = cfg.fused_epilogue == "on" or (
+            cfg.fused_epilogue == "auto"
+            and self._agg_impl == "pallas"
+            and self.fault is None
+        )
 
         # server optimizer over the pseudo-gradient (FedAvgM / FedAdam);
         # "none" = take the aggregate directly (reference :354-358)
@@ -474,8 +487,24 @@ class FedTrainer:
             fault_state = (stale, ge_bad)
 
         with jax.named_scope("channel"):
+            # k_chan is consumed (or deliberately unused) on every branch,
+            # so toggling fusion never shifts the round's RNG stream
+            oma_key = None
             if cfg.noise_var is not None and agg_lib.needs_oma_prepass(cfg.agg):
-                w_stack = channel_lib.oma(k_chan, w_stack, cfg.noise_var)
+                if (
+                    self._fused_epilogue
+                    and agg_lib.supports_fused_epilogue(cfg.agg)
+                    and cfg.bucket_size == 1
+                    and self._stack_dtype == jnp.float32
+                ):
+                    # defer the channel: the aggregator folds the OMA
+                    # corruption into its single stack read (bucketing must
+                    # see the post-channel stack, and a bf16 stack would
+                    # change what the channel noise lands on — both keep
+                    # the standalone pass)
+                    oma_key = k_chan
+                else:
+                    w_stack = channel_lib.oma(k_chan, w_stack, cfg.noise_var)
 
         agg_honest = m_h
         w_for_agg = w_stack
@@ -520,6 +549,10 @@ class FedTrainer:
                 tol=cfg.agg_tol,
                 p_max=cfg.gm_p_max,
                 impl=self._agg_impl,
+                # single-read selection epilogue + deferred channel
+                # (ops/aggregators.py dispatch; **_ on other aggregators)
+                fused_epilogue=self._fused_epilogue,
+                oma_key=oma_key,
                 m=cfg.krum_m,
                 clip_tau=cfg.clip_tau,
                 clip_iters=cfg.clip_iters,

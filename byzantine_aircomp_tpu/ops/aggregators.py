@@ -90,19 +90,144 @@ def mean(wmatrix: jnp.ndarray, *, degraded: bool = False, **_) -> jnp.ndarray:
     return jnp.mean(wmatrix.astype(jnp.float32), axis=0)
 
 
+# ---------------------------------------------------------------------------
+# fused aggregation epilogue: selection instead of sort, optional channel fuse
+#
+# The sort-family aggregators (median / trimmed_mean) pay a full XLA bitonic
+# sort over the [K, d] stack — >= 3 stack-sized HBM round trips — plus a
+# standalone OMA channel pass before them.  With ``fused_epilogue`` the
+# dispatch below replaces that with (a) the single-HBM-pass Pallas peel
+# kernels when ``impl="pallas"`` fits the VMEM regime, or (b) an XLA
+# order-statistic selection (32-step bisection over IEEE-754 total-order
+# int32 keys) that beats the sort everywhere else; either way the OMA
+# corruption (``oma_key``) folds into the same stack read instead of a
+# separate pass.  Fallback matrix in docs/DESIGN.md: degraded mode, non-f32
+# stacks, out-of-VMEM K, or an empty kept band all take the sort path
+# (applying ``channel.oma`` first when the channel was deferred), which is
+# bit-identical to the pre-fusion two-pass pipeline.
+
+
+def _nth_smallest_keys(keys: jnp.ndarray, n) -> jnp.ndarray:
+    """Per-column n-th smallest (0-indexed) int32 total-order key.
+
+    32-step bisection on the key VALUE domain: each step counts
+    ``keys <= mid`` per column, so the work is 32 cheap comparison passes
+    instead of a full K-length sort — on CPU/GPU this is the fast
+    realization of the selection epilogue (ties, +-Inf and positive NaN
+    rank exactly as in ``jnp.sort``; see pallas_kernels.total_order_keys).
+    """
+    cols = keys.shape[1]
+    lo = jnp.full((cols,), -(2**31), jnp.int32)
+    hi = jnp.full((cols,), 2**31 - 1, jnp.int32)
+
+    def step(_, lohi):
+        lo, hi = lohi
+        # overflow-free floor((lo + hi) / 2) in int32
+        mid = (lo >> 1) + (hi >> 1) + (lo & hi & 1)
+        cnt = jnp.sum(keys <= mid[None, :], axis=0)
+        above = cnt <= n  # not enough at-or-below mid -> answer is above
+        return jnp.where(above, mid + 1, lo), jnp.where(above, hi, mid)
+
+    lo, _ = jax.lax.fori_loop(0, 32, step, (lo, hi))
+    return lo
+
+
+def _select_median(wmatrix: jnp.ndarray) -> jnp.ndarray:
+    k = wmatrix.shape[0]
+    keys = pallas_kernels.total_order_keys(wmatrix)
+    return pallas_kernels.total_order_vals(
+        _nth_smallest_keys(keys, (k - 1) // 2)
+    )
+
+
+def _select_trimmed_mean(wmatrix: jnp.ndarray, b: int) -> jnp.ndarray:
+    """b-trimmed column mean without sorting: locate the kept band's
+    boundary order statistics by key bisection, sum the strict interior in
+    one masked pass, and add back the boundary values times their kept
+    multiplicity (exact under ties at the trim boundary)."""
+    k = wmatrix.shape[0]
+    w32 = wmatrix.astype(jnp.float32)
+    keys = pallas_kernels.total_order_keys(w32)
+    lo_k = _nth_smallest_keys(keys, b)          # rank b (lowest kept)
+    hi_k = _nth_smallest_keys(keys, k - b - 1)  # rank K-b-1 (highest kept)
+    interior = (keys > lo_k[None, :]) & (keys < hi_k[None, :])
+    total = jnp.sum(jnp.where(interior, w32, 0.0), axis=0)
+    # kept ranks are [b, K-b-1]; entries equal to a boundary key occupy the
+    # contiguous rank run [#(< key), #(<= key) - 1] — clip it to the band
+    last = k - b - 1
+
+    def kept_copies(boundary):
+        n_lt = jnp.sum(keys < boundary[None, :], axis=0)
+        n_le = jnp.sum(keys <= boundary[None, :], axis=0)
+        run = jnp.minimum(n_le - 1, last) - jnp.maximum(n_lt, b) + 1
+        return jnp.maximum(run, 0).astype(jnp.float32)
+
+    def boundary_sum(boundary, copies):
+        # 0 * Inf / 0 * NaN guard: contribute only where copies exist
+        v = pallas_kernels.total_order_vals(boundary)
+        return jnp.where(copies > 0, copies * v, 0.0)
+
+    total = total + boundary_sum(lo_k, kept_copies(lo_k))
+    total = total + jnp.where(
+        lo_k == hi_k, 0.0, boundary_sum(hi_k, kept_copies(hi_k))
+    )
+    return total / jnp.float32(k - 2 * b)
+
+
+def supports_fused_epilogue(name: str) -> bool:
+    """Aggregators whose epilogue the fused dispatch below accelerates (and
+    into whose stack read the OMA prepass may be folded).  gm already owns
+    its channel in-kernel (``aircomp_weiszfeld_step``)."""
+    return name in ("median", "trimmed_mean")
+
+
 @AGGREGATORS.register("median")
-def median(wmatrix: jnp.ndarray, *, degraded: bool = False, **_) -> jnp.ndarray:
+def median(
+    wmatrix: jnp.ndarray,
+    *,
+    degraded: bool = False,
+    impl: str = "xla",
+    fused_epilogue: bool = False,
+    oma_key: Optional[jax.Array] = None,
+    noise_var: Optional[float] = None,
+    **_,
+) -> jnp.ndarray:
     """Coordinatewise median, torch semantics (lower-middle for even K).
 
     Reference ``median`` (``:194-195``) uses ``torch.median(dim=0)`` which
     returns the ``(K-1)//2``-th order statistic, not the midpoint average.
 
+    ``fused_epilogue``: replace the full sort with single-read selection —
+    the Pallas peel kernel (``impl="pallas"``, K fits VMEM) or the XLA key
+    bisection — optionally folding the deferred OMA prepass (``oma_key``)
+    into the same stack read.  Off (the default) this body is byte-for-byte
+    the pre-fusion aggregator.
+
     ``degraded``: the median of the n finite rows — non-finite rows sort to
     +Inf and the order statistic index becomes the DYNAMIC ``(n-1)//2``, so
     the rule adapts to the per-round effective K instead of drifting toward
     the +Inf tail.  n = 0 returns +Inf (trainer finite-guard territory).
+    Degraded rounds always take the sort path (the dynamic index defeats
+    static peel/bisection bounds).
     """
     k = wmatrix.shape[0]
+    if fused_epilogue and not degraded and wmatrix.dtype == jnp.float32:
+        if impl == "pallas" and pallas_kernels.supports_sort_fused(
+            k, oma_key is not None
+        ):
+            ch = (
+                channel.oma_terms(oma_key, k, wmatrix.shape[1], noise_var)
+                if oma_key is not None
+                else None
+            )
+            return pallas_kernels.fused_median(wmatrix, channel=ch)
+        if oma_key is not None:
+            wmatrix = channel.oma(oma_key, wmatrix, noise_var)
+        return _select_median(wmatrix)
+    if oma_key is not None:
+        # fallback owed the deferred channel pass — bit-identical to the
+        # standalone prepass in fed/train.py under the same key
+        wmatrix = channel.oma(oma_key, wmatrix, noise_var)
     if degraded:
         finite = _finite_rows(wmatrix)
         n = jnp.sum(finite)
@@ -118,7 +243,10 @@ def median(wmatrix: jnp.ndarray, *, degraded: bool = False, **_) -> jnp.ndarray:
 @AGGREGATORS.register("trimmed_mean")
 def trimmed_mean(
     wmatrix: jnp.ndarray, *, trim_ratio: float = 0.1,
-    beta: Optional[int] = None, degraded: bool = False, **_
+    beta: Optional[int] = None, degraded: bool = False,
+    impl: str = "xla", fused_epilogue: bool = False,
+    oma_key: Optional[jax.Array] = None,
+    noise_var: Optional[float] = None, **_
 ) -> jnp.ndarray:
     """Coordinatewise beta-trimmed mean.
 
@@ -126,13 +254,36 @@ def trimmed_mean(
     coordinate, matching the reference's chained double-``topk``
     (``:189-192``) which keeps the middle K - 2*beta order statistics.
 
+    ``fused_epilogue`` / ``oma_key``: single-read selection epilogue with
+    optional in-read OMA — same dispatch and fallback matrix as
+    :func:`median`; requires a non-empty kept band (K - 2b >= 1).
+
     ``degraded``: the trim budget adapts to the per-round effective K —
     b = floor(n * trim_ratio) over the n finite rows (an explicit ``beta``
     is clamped to (n-1)//2 so the kept middle band is never empty); the
     static-shape sort keeps non-finite rows at +Inf and a dynamic rank mask
     selects the kept band.  n = 0 returns NaN (trainer finite-guard).
+    Degraded rounds always take the sort path (dynamic trim budget).
     """
     k = wmatrix.shape[0]
+    if fused_epilogue and not degraded and wmatrix.dtype == jnp.float32:
+        b = int(k * trim_ratio) if beta is None else int(beta)
+        if 0 <= b and k - 2 * b >= 1:
+            if impl == "pallas" and pallas_kernels.supports_sort_fused(
+                k, oma_key is not None
+            ):
+                ch = (
+                    channel.oma_terms(oma_key, k, wmatrix.shape[1], noise_var)
+                    if oma_key is not None
+                    else None
+                )
+                return pallas_kernels.fused_trimmed_mean(wmatrix, b, channel=ch)
+            if oma_key is not None:
+                wmatrix = channel.oma(oma_key, wmatrix, noise_var)
+            return _select_trimmed_mean(wmatrix, b)
+    if oma_key is not None:
+        # fallback owed the deferred channel pass (see median)
+        wmatrix = channel.oma(oma_key, wmatrix, noise_var)
     if degraded:
         finite = _finite_rows(wmatrix)
         n = jnp.sum(finite)

@@ -74,14 +74,17 @@ def csi_error_scale(
     return jnp.exp(-eps)
 
 
-def oma(key: jax.Array, message: jnp.ndarray, noise_var: float) -> jnp.ndarray:
-    """Per-client orthogonal-link corruption of a [K, d] message stack.
+def oma_terms(key: jax.Array, k: int, d: int, noise_var: float):
+    """The OMA link's random terms, drawn WITHOUT touching the message.
 
-    Returns ``message + (h_r*n_r + h_i*n_i)/|h|^2`` with per-client scalar
-    fades and elementwise noise of std ``sqrt(noise_var)``
-    (reference ``OMA``, ``MNIST_Air_weight.py:385-394``).
+    Returns ``(h_r, h_i, h_sq, n_r, n_i)`` — per-client fade components and
+    floored squared magnitude ([K]), and the scaled complex-noise draws
+    ([K, d]).  Split out of :func:`oma` so the fused aggregation epilogue
+    (ops/pallas_kernels.py selection kernels) can apply the channel inside
+    its single stack read while consuming the EXACT key derivation and
+    elementwise op order of the standalone pass — the two paths are
+    bit-compatible under a fixed key.
     """
-    k, d = message.shape
     key_h, key_nr, key_ni = jax.random.split(key, 3)
     h_r, h_i = rayleigh_fade(key_h, k)
     scale = jnp.sqrt(jnp.asarray(noise_var, jnp.float32))
@@ -90,8 +93,20 @@ def oma(key: jax.Array, message: jnp.ndarray, noise_var: float) -> jnp.ndarray:
     # the floor keeps a deep fade from exploding the residual to +-Inf
     # (P(|h|^2 < HSQ_FLOOR) ~ 1e-6 per draw for unit-power Rayleigh, so
     # draws above the floor are bit-identical to the unfloored division)
-    h_sq = jnp.maximum((h_r**2 + h_i**2)[:, None], HSQ_FLOOR)
-    de_noise = (h_r[:, None] * n_r + h_i[:, None] * n_i) / h_sq
+    h_sq = jnp.maximum(h_r**2 + h_i**2, HSQ_FLOOR)
+    return h_r, h_i, h_sq, n_r, n_i
+
+
+def oma(key: jax.Array, message: jnp.ndarray, noise_var: float) -> jnp.ndarray:
+    """Per-client orthogonal-link corruption of a [K, d] message stack.
+
+    Returns ``message + (h_r*n_r + h_i*n_i)/|h|^2`` with per-client scalar
+    fades and elementwise noise of std ``sqrt(noise_var)``
+    (reference ``OMA``, ``MNIST_Air_weight.py:385-394``).
+    """
+    k, d = message.shape
+    h_r, h_i, h_sq, n_r, n_i = oma_terms(key, k, d, noise_var)
+    de_noise = (h_r[:, None] * n_r + h_i[:, None] * n_i) / h_sq[:, None]
     return message + de_noise
 
 

@@ -31,6 +31,18 @@ a [TK, d_padded] f32 block stays within a 4 MB VMEM budget; models whose
 flat dimension exceeds ``MAX_FUSED_DIM`` (single tile would not fit even at
 TK=8) fall back to the XLA path at the call site.
 
+The sort-family kernels (:func:`fused_trimmed_mean`, :func:`fused_median`)
+extend the same contract to the order-statistic aggregators: the grid runs
+over d-tiles with the FULL K column resident ([Kp, 128] per program), so the
+[K, d] stack is read from HBM exactly once per call.  Instead of a bitonic
+sort they peel b extremes per column with an alive-mask (b <= K/2, so
+peeling wins on both FLOPs and HBM traffic), ordering by IEEE-754
+total-order int32 keys so ties, +-Inf and (positive) NaN rank exactly like
+``jnp.sort``.  The OMA channel transform (per-client fade gain, noise add,
+|h|^2 descale) can be fused into the same tile read — fades/noise are drawn
+OUTSIDE with ``jax.random`` (``channel.oma_terms``) so the fused path is
+bit-compatible with the standalone ``channel.oma`` pass.
+
 CPU (tests / no-TPU) runs use ``interpret=True`` automatically.
 """
 
@@ -231,3 +243,154 @@ def aircomp_weiszfeld_step(
         interpret=interp,
     )(w_p, g_p, hsq_p, scaler.reshape(1).astype(jnp.float32))
     return num[0, :d], den[0, 0]
+
+
+# ---------------------------------------------------------------------------
+# sort-family selection kernels (trimmed_mean / median)
+
+_KEY_MIN = -(2**31)
+_KEY_MAX = 2**31 - 1
+
+# VMEM residency per [Kp, 128] program of the selection kernels: values,
+# int32 keys and the alive mask always; + the n_r/n_i noise tiles when the
+# OMA channel is fused (the [Kp, 1] fade vectors are noise)
+SELECT_STACK_ARRAYS = 3
+SELECT_CHANNEL_ARRAYS = 2
+
+
+def total_order_keys(v: jnp.ndarray) -> jnp.ndarray:
+    """f32 -> int32 keys whose integer order is the IEEE-754 total order.
+
+    Positive floats keep their bit pattern (already ordered as int); for
+    negative floats the pattern is bit-complemented into [0, 2^31-1] and
+    shifted down by 2^31, reversing their order without overflow.  +-0.0
+    become distinct adjacent keys (-0.0 < +0.0), positive NaN ranks above
+    +Inf exactly like ``jnp.sort``; NEGATIVE NaN ranks below -Inf where
+    ``jnp.sort`` would put it last — callers that can see negative NaN
+    (never produced by this codebase's faults/attacks) must fall back.
+    """
+    i = jax.lax.bitcast_convert_type(v.astype(jnp.float32), jnp.int32)
+    return jnp.where(i < 0, jnp.bitwise_not(i) + jnp.int32(_KEY_MIN), i)
+
+
+def total_order_vals(keys: jnp.ndarray) -> jnp.ndarray:
+    """Exact inverse of :func:`total_order_keys` (bit-roundtrip, NaNs too)."""
+    i = jnp.where(
+        keys < 0, jnp.bitwise_not(keys - jnp.int32(_KEY_MIN)), keys
+    )
+    return jax.lax.bitcast_convert_type(i, jnp.float32)
+
+
+def supports_sort_fused(k: int, channel: bool = False) -> bool:
+    """Whether a selection kernel can hold a full-K [Kp, 128] working set
+    (values + keys + mask, + noise tiles when the channel is fused) in the
+    VMEM block budget.  K-bound, unlike :func:`supports_fused` (d-bound):
+    the selection grid runs over d, so d never limits residency."""
+    kp = _round_up(k, 8)
+    n = SELECT_STACK_ARRAYS + (SELECT_CHANNEL_ARRAYS if channel else 0)
+    return n * kp * LANE * 4 <= VMEM_BLOCK_BUDGET
+
+
+def _select_kernel(k_actual, kp, n_low, n_high, want_mean, channel, *refs):
+    """One [Kp, 128] column block: optional fused OMA, then peel ``n_high``
+    maxes and ``n_low`` mins per column and emit the trimmed column mean
+    (``want_mean``) or the max of the survivors (the order statistic)."""
+    if channel:
+        w_ref, nr_ref, ni_ref, hr_ref, hi_ref, hsq_ref, out_ref = refs
+    else:
+        w_ref, out_ref = refs
+    w = w_ref[:].astype(jnp.float32)
+    if channel:
+        # identical elementwise op order to channel.oma -> bit-compatible
+        # with the standalone two-pass channel apply
+        w = w + (hr_ref[:] * nr_ref[:] + hi_ref[:] * ni_ref[:]) / hsq_ref[:]
+    keys = total_order_keys(w)
+    row = jax.lax.broadcasted_iota(jnp.int32, keys.shape, 0)
+    alive = row < k_actual  # padded rows never participate
+
+    def peel_one(alive, fill, reduce):
+        masked = jnp.where(alive, keys, fill)
+        m = reduce(masked, axis=0)  # [128] current per-column extreme
+        hit = jnp.logical_and(alive, keys == m[None, :])
+        # first row index attaining the extreme — exactly ONE entry peels
+        # per iteration, so boundary ties trim like a sort would
+        first = jnp.min(jnp.where(hit, row, kp), axis=0)
+        return jnp.logical_and(alive, row != first[None, :])
+
+    alive = jax.lax.fori_loop(
+        0, n_high,
+        lambda _, a: peel_one(a, jnp.int32(_KEY_MIN), jnp.max), alive,
+    )
+    alive = jax.lax.fori_loop(
+        0, n_low,
+        lambda _, a: peel_one(a, jnp.int32(_KEY_MAX), jnp.min), alive,
+    )
+    if want_mean:
+        kept = jnp.float32(k_actual - n_low - n_high)
+        out_ref[:] = (
+            jnp.sum(jnp.where(alive, w, 0.0), axis=0, keepdims=True) / kept
+        )
+    else:
+        m = jnp.max(
+            jnp.where(alive, keys, jnp.int32(_KEY_MIN)), axis=0, keepdims=True
+        )
+        out_ref[:] = total_order_vals(m)
+
+
+def _select_call(w, n_low, n_high, want_mean, channel_terms, interpret):
+    k, d = w.shape
+    kp = _round_up(k, 8)
+    dp = _round_up(d, LANE)
+    w_p = _pad2(w.astype(jnp.float32), kp, dp)
+    interp = _use_interpret() if interpret is None else interpret
+
+    col = pl.BlockSpec((kp, LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    vec = pl.BlockSpec((kp, 1), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    in_specs, operands = [col], [w_p]
+    if channel_terms is not None:
+        h_r, h_i, h_sq, n_r, n_i = channel_terms
+        pad1 = lambda v, fill: jnp.pad(
+            v.reshape(-1, 1), ((0, kp - k), (0, 0)), constant_values=fill
+        )
+        in_specs += [col, col, vec, vec, vec]
+        # padded rows get h_sq = 1 against 0/0; they are masked anyway
+        operands += [
+            _pad2(n_r, kp, dp), _pad2(n_i, kp, dp),
+            pad1(h_r, 0.0), pad1(h_i, 0.0), pad1(h_sq, 1.0),
+        ]
+
+    out = pl.pallas_call(
+        functools.partial(
+            _select_kernel, k, kp, n_low, n_high, want_mean,
+            channel_terms is not None,
+        ),
+        grid=(dp // LANE,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, LANE), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, dp), jnp.float32),
+        interpret=interp,
+    )(*operands)
+    return out[0, :d]
+
+
+@functools.partial(jax.jit, static_argnames=("b", "interpret"))
+def fused_trimmed_mean(w, b: int, *, channel=None, interpret=None):
+    """Single-HBM-pass b-trimmed column mean of a [K, d] stack.
+
+    ``channel``: optional ``(h_r, h_i, h_sq, n_r, n_i)`` from
+    ``channel.oma_terms`` — fuses the OMA corruption into the same tile
+    read.  Caller guarantees ``K - 2b >= 1`` (ops/aggregators.py gates).
+    """
+    return _select_call(w, b, b, True, channel, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_median(w, *, channel=None, interpret=None):
+    """Single-HBM-pass coordinatewise median (torch lower-middle order
+    statistic, matching the XLA path): peel ``K - 1 - (K-1)//2`` maxes,
+    then the max of the survivors is ``sorted[(K-1)//2]``."""
+    k = w.shape[0]
+    n_high = k - 1 - (k - 1) // 2
+    return _select_call(w, 0, n_high, False, channel, interpret)

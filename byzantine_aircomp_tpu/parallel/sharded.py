@@ -70,6 +70,15 @@ class ShardedFedTrainer(FedTrainer):
         # into per-shard psums.  (Set before the round fn's first trace.)
         if self._agg_impl == "pallas" and self.mesh.size > 1:
             self._agg_impl = "xla"
+        # Same constraint for the fused sort-family epilogue: its pallas
+        # realization is a pallas_call over the client-sharded stack, and
+        # even the XLA selection realization would interleave the deferred
+        # in-aggregator channel apply with GSPMD resharding decisions we
+        # have only validated single-device.  Multi-device meshes keep the
+        # standalone channel pass + sort path (whose psum partitioning is
+        # the tested layout); set before the round fn's first trace.
+        if self.mesh.size > 1:
+            self._fused_epilogue = False
         # Krum on a client-sharded stack: route through the explicit
         # ppermute ring (collective.ring_krum*) instead of letting GSPMD
         # partition the K x K Gram matmul, which can lower to an all-gather

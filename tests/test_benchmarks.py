@@ -74,3 +74,31 @@ def test_impl_ab_bench_tiny_baseline_end_to_end():
     rec = json.loads(r.stdout.strip().splitlines()[-1])
     assert rec["metric"] == "ab_rounds_per_sec_agg_xla"
     assert len(rec["blocks"]) == 2 and all(b > 0 for b in rec["blocks"])
+
+
+def test_agg_kernels_bench_quick_tier_json():
+    """The sort-family epilogue microbench must stay runnable under the
+    CPU backend at a scaled-down shape (quick tier): every row parses as
+    JSON, the per-impl rows carry the HBM model, and the summary row's
+    acceptance booleans hold (fused pallas reads the stack ~once, parity
+    within 1e-5, platform fused realization not slower)."""
+    r = _run(
+        "agg_kernels.py", "--k", "24", "--d", "256", "--iters", "1",
+        timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(line) for line in r.stdout.strip().splitlines()]
+    summary = rows[-1]
+    assert summary["metric"] == "agg_epilogue_summary"
+    assert summary["single_hbm_pass"] and summary["parity_ok"]
+    per_impl = [row for row in rows if row["metric"] == "agg_epilogue"]
+    # 2 aggs x 2 channel modes x 3 impls
+    assert len(per_impl) == 12
+    for row in per_impl:
+        assert row["hbm_bytes"] >= row["stack_bytes"]
+        if row["impl"] == "pallas" and not row["channel"]:
+            assert row["hbm_x"] <= 1.1  # single HBM pass over the stack
+        if row["impl"] == "sort":
+            assert row["hbm_x"] >= 3.0  # sort path lower bound
+        if row["impl"] != "pallas":  # pallas rows untimed off-TPU
+            assert row["mean_ms"] > 0

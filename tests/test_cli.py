@@ -134,7 +134,8 @@ def test_profile_dir_writes_a_trace(tmp_path):
 
 
 def test_run_titles_distinct_across_extension_knobs():
-    # checkpoint/cache paths key on run_title: configs differing in any
+    # cache paths and record keys use run_title (checkpoints additionally
+    # fold in config_hash via ckpt_title): configs differing in any
     # framework-extension knob must never collide (the B=5/B=10 collision
     # in the reproduce pipeline came from exactly this class of gap —
     # K/B live in the cache filename prefix, everything else must be in
@@ -184,3 +185,25 @@ def test_run_titles_distinct_across_extension_knobs():
         run_title(FedConfig(honest_size=8, **v)) for v in variants
     ]
     assert len(set(titles)) == len(titles), titles
+
+
+def test_ckpt_title_separates_configs_run_title_conflates():
+    # run_title is reference-compatible and deliberately omits seed, sizes,
+    # dataset, batch_size, gamma and widths — checkpoints key on ckpt_title
+    # (title + short config hash) so such runs can never silently resume
+    # each other's state
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+    from byzantine_aircomp_tpu.fed.harness import ckpt_title, config_hash, run_title
+
+    a = FedConfig(honest_size=8, seed=2021)
+    b = FedConfig(honest_size=8, seed=2022)
+    c = FedConfig(honest_size=10, seed=2021)
+    assert run_title(a) == run_title(b) == run_title(c)
+    assert len({ckpt_title(a), ckpt_title(b), ckpt_title(c)}) == 3
+    assert ckpt_title(a).startswith(run_title(a) + "_c")
+    # stable within a process and across path-only knobs the state does
+    # not depend on
+    assert config_hash(a) == config_hash(FedConfig(honest_size=8, seed=2021))
+    assert config_hash(a) == config_hash(
+        FedConfig(honest_size=8, seed=2021, checkpoint_dir="/elsewhere/")
+    )

@@ -1,0 +1,297 @@
+"""Fused sort-family aggregation epilogue: parity + fallback matrix.
+
+Covers the three layers of the single-HBM-pass epilogue (docs/DESIGN.md):
+
+* the IEEE-754 total-order key machinery and the VMEM gate in
+  ``ops/pallas_kernels.py``;
+* the XLA key-bisection selection and the Pallas peel kernel against the
+  sort path, on random AND adversarial stacks (ties pinned at the trim
+  boundary, +-Inf rows, NaN rows, b = 0);
+* channel fusion: the deferred OMA prepass folded into the aggregation
+  read must match the standalone two-pass under the same key — bitwise
+  for the XLA realization, 1e-5 for the Pallas kernel (FMA contraction);
+* fallbacks: degraded mode and non-f32 stacks must land on the sort body
+  bit-identically, with a deferred ``oma_key`` still honored.
+
+Pallas runs in interpret mode here (conftest forces the CPU backend); the
+same kernels compile via Mosaic on TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu.ops import aggregators as agg_lib
+from byzantine_aircomp_tpu.ops import channel as channel_lib
+from byzantine_aircomp_tpu.ops import pallas_kernels as pk
+
+
+def _stack(k=25, d=300, seed=0):
+    base = jax.random.normal(jax.random.PRNGKey(seed), (1, d)) * 0.01
+    w = base + 1e-3 * jax.random.normal(jax.random.PRNGKey(seed + 1), (k, d))
+    return w.astype(jnp.float32)
+
+
+def _adversarial_stack(k=25, d=300, seed=0):
+    """Rows engineered against selection epilogues: +-Inf rows, a positive
+    NaN row (the fault layer's), and a tie block wide enough to straddle
+    any b <= k//4 trim boundary."""
+    w = _stack(k, d, seed)
+    w = w.at[0].set(jnp.inf)
+    w = w.at[1].set(-jnp.inf)
+    w = w.at[2].set(jnp.nan)
+    w = w.at[3 : 3 + k // 3].set(0.5)
+    w = w.at[-2].set(-0.0)  # signed-zero total-order case
+    return w
+
+
+# ---------------------------------------------------------------------------
+# total-order keys
+
+
+def test_total_order_keys_roundtrip_and_order():
+    v = jnp.array(
+        [-jnp.inf, -1e30, -1.5, -0.0, 0.0, 2e-38, 1.5, 1e30, jnp.inf, jnp.nan],
+        dtype=jnp.float32,
+    )
+    keys = pk.total_order_keys(v)
+    # strictly increasing in the listed order: -0.0 < +0.0 and NaN (positive
+    # payload) above +Inf — the jnp.sort NaN-last convention
+    assert bool(jnp.all(keys[1:] > keys[:-1]))
+    back = pk.total_order_vals(keys)
+    assert np.array_equal(
+        np.asarray(v).view(np.uint32), np.asarray(back).view(np.uint32)
+    ), "roundtrip must be bit-exact, including NaN payload and -0.0"
+
+
+def test_supports_sort_fused_vmem_gate():
+    assert pk.supports_sort_fused(25)
+    assert pk.supports_sort_fused(1000, channel=True)
+    # 3 stack-resident arrays * K * 128 lanes * 4B must exceed the budget
+    too_big = pk.VMEM_BLOCK_BUDGET // (pk.SELECT_STACK_ARRAYS * 128 * 4) + 8
+    assert not pk.supports_sort_fused(too_big)
+    # the channel variant keeps 2 more arrays resident -> tighter K ceiling
+    k = 2048
+    while pk.supports_sort_fused(k, channel=True):
+        k += 512
+    assert pk.supports_sort_fused(k - 512, channel=True)
+    assert pk.supports_sort_fused(k - 512, channel=False)
+
+
+# ---------------------------------------------------------------------------
+# selection vs sort parity
+
+
+CASES = [(25, 300), (16, 128), (9, 140)]
+
+
+@pytest.mark.parametrize("k,d", CASES)
+@pytest.mark.parametrize("adversarial", [False, True])
+def test_select_median_matches_sort(k, d, adversarial):
+    w = _adversarial_stack(k, d) if adversarial else _stack(k, d)
+    ref = agg_lib.median(w)
+    got = agg_lib.median(w, fused_epilogue=True)
+    assert np.array_equal(
+        np.asarray(ref).view(np.uint32), np.asarray(got).view(np.uint32)
+    ), "XLA selection median must be bit-exact vs the sort path"
+
+
+@pytest.mark.parametrize("k,d", CASES)
+@pytest.mark.parametrize("adversarial", [False, True])
+@pytest.mark.parametrize("trim_ratio", [0.0, 0.2])
+def test_select_trimmed_mean_matches_sort(k, d, adversarial, trim_ratio):
+    w = _adversarial_stack(k, d) if adversarial else _stack(k, d)
+    ref = np.asarray(agg_lib.trimmed_mean(w, trim_ratio=trim_ratio))
+    got = np.asarray(
+        agg_lib.trimmed_mean(w, trim_ratio=trim_ratio, fused_epilogue=True)
+    )
+    # b = 0 on the adversarial stack keeps the Inf/NaN rows: the kept-band
+    # sum is then non-finite and both paths must agree on WHICH non-finite
+    both = np.isfinite(ref) & np.isfinite(got)
+    assert np.array_equal(np.isnan(ref), np.isnan(got))
+    assert np.array_equal(np.isposinf(ref), np.isposinf(got))
+    assert np.array_equal(np.isneginf(ref), np.isneginf(got))
+    if both.any():
+        np.testing.assert_allclose(got[both], ref[both], atol=1e-6, rtol=1e-6)
+
+
+def test_select_trimmed_mean_boundary_ties_exact():
+    """Duplicate values pinned exactly AT both trim boundaries: the rank-run
+    correction must count kept copies like the sort does."""
+    k, d, b = 12, 64, 3
+    w = jnp.tile(
+        jnp.array([0.5] * 5 + [1.5] * 4 + [-2.0, 9.0, 0.5], dtype=jnp.float32)[
+            :, None
+        ],
+        (1, d),
+    )
+    ref = np.asarray(agg_lib.trimmed_mean(w, beta=b))
+    got = np.asarray(agg_lib.trimmed_mean(w, beta=b, fused_epilogue=True))
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+@pytest.mark.parametrize("k,d", [(25, 300), (16, 128)])
+@pytest.mark.parametrize("adversarial", [False, True])
+def test_pallas_kernels_match_sort(k, d, adversarial):
+    w = _adversarial_stack(k, d) if adversarial else _stack(k, d)
+    med_ref = np.asarray(agg_lib.median(w))
+    med_got = np.asarray(pk.fused_median(w, interpret=True))
+    assert np.array_equal(
+        med_ref.view(np.uint32), med_got.view(np.uint32)
+    ), "peel median selects an existing element: bit-exact"
+    tm_ref = np.asarray(agg_lib.trimmed_mean(w, trim_ratio=0.2))
+    tm_got = np.asarray(
+        pk.fused_trimmed_mean(w, int(k * 0.2), interpret=True)
+    )
+    np.testing.assert_allclose(tm_got, tm_ref, atol=1e-5)
+
+
+def test_dispatch_routes_pallas():
+    """median(impl='pallas', fused_epilogue=True) must agree with the sort
+    path through the real aggregator entry point."""
+    w = _stack(17, 260)
+    ref = np.asarray(agg_lib.median(w))
+    got = np.asarray(agg_lib.median(w, impl="pallas", fused_epilogue=True))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# channel fusion
+
+
+@pytest.mark.parametrize("agg,kw", [("median", {}), ("trimmed_mean", {"trim_ratio": 0.2})])
+def test_channel_fused_xla_bitwise_vs_two_pass(agg, kw):
+    """Deferring the OMA prepass into the XLA selection read must be
+    BITWISE identical to the standalone channel pass + fused aggregation:
+    oma_terms uses oma's exact key derivation and op order."""
+    w = _stack(20, 200)
+    key = jax.random.PRNGKey(123)
+    fn = agg_lib.resolve(agg)
+    two_pass = np.asarray(
+        fn(channel_lib.oma(key, w, 1e-2), fused_epilogue=True, **kw)
+    )
+    fused = np.asarray(
+        fn(w, fused_epilogue=True, oma_key=key, noise_var=1e-2, **kw)
+    )
+    assert np.array_equal(two_pass.view(np.uint32), fused.view(np.uint32))
+
+
+def test_channel_fused_pallas_close_to_two_pass():
+    """The Pallas kernel computes the same de-noise expression in-tile;
+    FMA contraction allows a few ULP vs the XLA two-pass."""
+    w = _stack(20, 200)
+    key = jax.random.PRNGKey(123)
+    two_pass = np.asarray(
+        agg_lib.median(channel_lib.oma(key, w, 1e-2))
+    )
+    fused = np.asarray(
+        agg_lib.median(
+            w, impl="pallas", fused_epilogue=True, oma_key=key, noise_var=1e-2
+        )
+    )
+    np.testing.assert_allclose(fused, two_pass, atol=1e-5)
+
+
+def test_oma_terms_recompose_oma_bitwise():
+    key = jax.random.PRNGKey(7)
+    w = _stack(15, 90)
+    h_r, h_i, h_sq, n_r, n_i = channel_lib.oma_terms(key, 15, 90, 1e-2)
+    recomposed = w + (h_r[:, None] * n_r + h_i[:, None] * n_i) / h_sq[:, None]
+    direct = channel_lib.oma(key, w, 1e-2)
+    assert np.array_equal(
+        np.asarray(recomposed).view(np.uint32),
+        np.asarray(direct).view(np.uint32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# fallback matrix
+
+
+def test_degraded_falls_back_with_deferred_channel():
+    """degraded=True must take the sort body, applying a deferred oma_key
+    first — bit-identical to the explicit two-pass degraded call."""
+    w = _adversarial_stack(25, 120)
+    key = jax.random.PRNGKey(5)
+    for fn, kw in (
+        (agg_lib.median, {}),
+        (agg_lib.trimmed_mean, {"trim_ratio": 0.2}),
+    ):
+        ref = np.asarray(fn(channel_lib.oma(key, w, 1e-2), degraded=True, **kw))
+        got = np.asarray(
+            fn(
+                w, degraded=True, fused_epilogue=True,
+                oma_key=key, noise_var=1e-2, **kw,
+            )
+        )
+        assert np.array_equal(ref.view(np.uint32), got.view(np.uint32))
+
+
+def test_non_f32_stack_falls_back_bitwise():
+    w = _stack(16, 64).astype(jnp.bfloat16)
+    ref = np.asarray(agg_lib.median(w), dtype=np.float32)
+    got = np.asarray(agg_lib.median(w, fused_epilogue=True), dtype=np.float32)
+    assert np.array_equal(ref, got)
+
+
+def test_empty_kept_band_falls_back():
+    # K - 2b < 1: fused dispatch must refuse and match the sort body
+    w = _stack(4, 32)
+    ref = np.asarray(agg_lib.trimmed_mean(w, beta=2))
+    got = np.asarray(agg_lib.trimmed_mean(w, beta=2, fused_epilogue=True))
+    assert np.array_equal(ref.view(np.uint32), got.view(np.uint32))
+
+
+def test_supports_fused_epilogue_names():
+    assert agg_lib.supports_fused_epilogue("median")
+    assert agg_lib.supports_fused_epilogue("trimmed_mean")
+    assert not agg_lib.supports_fused_epilogue("gm")
+    assert not agg_lib.supports_fused_epilogue("krum")
+
+
+# ---------------------------------------------------------------------------
+# trainer threading
+
+
+def _tiny_cfg(**kw):
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+
+    base = dict(
+        honest_size=8, byz_size=2, rounds=2, display_interval=2,
+        batch_size=16, agg="trimmed_mean", attack="signflip",
+        eval_train=False, noise_var=1e-3,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+@pytest.mark.slow
+def test_trainer_fused_on_matches_off():
+    """--fused-epilogue on (XLA selection realization on CPU, deferred
+    channel) must reproduce the default two-pass sort training run: same
+    RNG stream (k_chan drawn unconditionally), bitwise channel fusion,
+    selection parity within float tolerance."""
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+
+    ds = data_lib.load("mnist", synthetic_train=1500, synthetic_val=300)
+    runs = {}
+    for mode in ("off", "on"):
+        tr = FedTrainer(_tiny_cfg(fused_epilogue=mode), dataset=ds)
+        assert tr._fused_epilogue is (mode == "on")
+        tr.train()
+        runs[mode] = np.asarray(tr.flat_params)
+    np.testing.assert_allclose(runs["on"], runs["off"], atol=1e-5)
+
+
+def test_trainer_auto_resolves_off_on_cpu():
+    """auto means: fused only when the pallas impl is active (TPU) and no
+    fault model — on the CPU test backend it must resolve to off, keeping
+    golden trajectories byte-stable."""
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+
+    ds = data_lib.load("mnist", synthetic_train=400, synthetic_val=100)
+    tr = FedTrainer(_tiny_cfg(rounds=1), dataset=ds)
+    assert tr._fused_epilogue is False
