@@ -38,7 +38,6 @@ first, exactly like ``fed/train.py`` without fusion.
 from __future__ import annotations
 
 import argparse
-import json
 import time
 
 # runnable as a plain script (`python benchmarks/agg_kernels.py`): the
@@ -49,6 +48,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from byzantine_aircomp_tpu import obs as obs_lib
+# the analytic HBM model lives in obs/hbm.py so the trainer's run_start
+# accounting and this bench can never drift apart; the old local copy is
+# this alias
+from byzantine_aircomp_tpu.obs.hbm import epilogue_hbm_bytes as hbm_model
 
 
 def bench_one(fn, args, iters: int):
@@ -76,30 +81,6 @@ def make_stack(key, k: int, d: int, adversarial: bool = False):
         w = w.at[2].set(jnp.nan)  # positive NaN (the fault layer's)
         w = w.at[3 : 3 + k // 4].set(0.5)  # tie block spanning the boundary
     return jax.block_until_ready(w.astype(jnp.float32))
-
-
-def hbm_model(impl: str, k: int, d: int, b: int, channel: bool) -> int:
-    """Analytic HBM bytes per aggregation epilogue (f32).  ``channel``
-    adds the OMA terms: the [K, d] noise pair for the fused reads, or the
-    standalone read-modify-write pass for the sort path."""
-    stack = k * d * 4
-    out = d * 4
-    if impl == "pallas":
-        kp, dp = -(-k // 8) * 8, -(-d // 128) * 128
-        tiles = (kp * dp * 4) * (3 if channel else 1)  # w (+ n_r, n_i)
-        return tiles + out
-    if impl == "select":
-        # keys materialize once (stack read), 32 bisection count passes
-        # re-read them, one final masked-sum pass reads values
-        core = stack * 34
-        if channel:
-            core += 3 * stack  # n_r + n_i reads, post-channel stack write
-        return core + out
-    # sort: LOWER bound — read stack, write sorted, re-read kept band
-    core = 3 * stack
-    if channel:
-        core += 4 * stack  # standalone OMA pass: read w, n_r, n_i, write
-    return core + out
 
 
 def main(argv=None) -> int:
@@ -133,11 +114,15 @@ def main(argv=None) -> int:
     w_adv = make_stack(key, k, d, adversarial=True)
     stack_bytes = k * d * 4
 
-    rows = []
+    # stdout rows + optional --out file share one schema-stamped writer;
+    # the file sink is atomic (whole-run artifact, not a growing stream)
+    sinks = [obs_lib.StdoutSink()]
+    if args.out:
+        sinks.append(obs_lib.JsonlSink(args.out, atomic=True))
+    sink = obs_lib.MultiSink(sinks) if len(sinks) > 1 else sinks[0]
 
     def emit(row):
-        rows.append(row)
-        print(json.dumps(row))
+        sink.emit(obs_lib.make_event("bench", **row))
 
     def sort_path(agg, mat, oma=False):
         if oma:
@@ -236,10 +221,7 @@ def main(argv=None) -> int:
     }
     emit(summary)
 
-    if args.out:
-        with open(args.out, "w") as fh:
-            for row in rows:
-                fh.write(json.dumps(row) + "\n")
+    sink.close()
     return 0
 
 

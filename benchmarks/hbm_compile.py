@@ -18,7 +18,13 @@ from this tool.
 from __future__ import annotations
 
 import argparse
-import json
+
+# runnable as a plain script (`python benchmarks/hbm_compile.py`): the
+# package lives in the repo root, one directory up
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from byzantine_aircomp_tpu import obs as obs_lib
 
 # same --set plumbing as trajectory.py, from the package (the old
 # ``from trajectory import _coerce`` only worked when this directory
@@ -59,7 +65,7 @@ def main(argv=None) -> int:
     tr = FedTrainer(cfg, dataset=ds)
     key = jax.random.fold_in(tr._base_key, 0)
     compiled = tr._round_fn.lower(
-        tr.flat_params, tr.server_opt_state, tr.client_m,
+        tr.flat_params, tr.server_opt_state, tr.client_m, tr.fault_state,
         key, tr.x_train, tr.y_train,
     ).compile()
     mem = compiled.memory_analysis()
@@ -77,7 +83,8 @@ def main(argv=None) -> int:
         "output_gib": round(mem.output_size_in_bytes / gib, 3),
         "alias_gib": round(mem.alias_size_in_bytes / gib, 3),
     }
-    print(json.dumps(out))
+    with obs_lib.StdoutSink() as sink:
+        sink.emit(obs_lib.make_event("bench", metric="hbm_compile", **out))
     return 0
 
 

@@ -31,10 +31,15 @@ seam.
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 import time
 
+# runnable as a plain script (`python benchmarks/trajectory.py`): the
+# package lives in the repo root, one directory up
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from byzantine_aircomp_tpu import obs as obs_lib
 from byzantine_aircomp_tpu.fed.config import FedConfig, coerce_field
 from byzantine_aircomp_tpu.fed.train import FedTrainer
 
@@ -119,18 +124,25 @@ def main(argv=None) -> int:
             print(f"resumed at round {start_round}", file=sys.stderr)
 
     t0 = time.perf_counter()
-    with open(args.out, "a") as fh:
-        if fh.tell() == 0:  # fresh file: always lead with the header line
-            fh.write(json.dumps({"config": kw, "dataset_rows": [
-                int(trainer.dataset.x_train.shape[0]),
-                int(trainer.dataset.x_val.shape[0]),
-            ]}) + "\n")
-            fh.flush()
+    # append-safe sink: each row is one flushed write, so a killed run keeps
+    # every completed round; schema stamps (v/kind/ts) are additive over the
+    # documented keys and trajectory_plot.py's membership checks
+    with obs_lib.JsonlSink(args.out) as sink:
+        if sink.fresh:  # fresh file: always lead with the header line
+            sink.emit(obs_lib.make_event(
+                "trajectory_header",
+                config=kw,
+                dataset_rows=[
+                    int(trainer.dataset.x_train.shape[0]),
+                    int(trainer.dataset.x_val.shape[0]),
+                ],
+            ))
         if start_round:
             # seam marker: `secs` is per-process wall clock, so cumulative
             # analyses must restart at each resume line
-            fh.write(json.dumps({"resumed": start_round}) + "\n")
-            fh.flush()
+            sink.emit(obs_lib.make_event(
+                "trajectory_resume", resumed=start_round
+            ))
         for r in range(start_round, cfg.rounds):
             trainer.run_round(r)
             loss, acc = trainer.evaluate("val")
@@ -148,8 +160,7 @@ def main(argv=None) -> int:
                 "val_acc": round(float(acc), 4),
                 "secs": round(time.perf_counter() - t0, 1),
             }
-            fh.write(json.dumps(row) + "\n")
-            fh.flush()
+            sink.emit(obs_lib.make_event("trajectory_row", **row))
             print(row, file=sys.stderr)
     return 0
 

@@ -20,12 +20,12 @@ stderr, and optionally an atomic pickle of the full grid (``--out``).
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import obs as obs_lib
 from ..fed.config import FedConfig
 from ..fed.train import FedTrainer
 from ..registry import AGGREGATORS, ATTACKS, FAULTS
@@ -167,6 +167,8 @@ def main(argv=None) -> None:
     ap.add_argument("--var", type=float, default=None)
     ap.add_argument("--seed", type=int, default=2021)
     ap.add_argument("--out", default=None, help="pickle the grid here")
+    ap.add_argument("--obs-dir", default=None,
+                    help="also append fault_cell events (JSONL) here")
     args = ap.parse_args(argv)
 
     aggs = [a for a in args.aggs.split(",") if a]
@@ -188,23 +190,32 @@ def main(argv=None) -> None:
         seed=args.seed,
         eval_train=False,
     )
-    grid = run_matrix(
-        aggs,
-        faults,
-        attacks,
-        cfg_kw,
-        on_cell=lambda agg, fault, attack, cell: print(
-            json.dumps(
-                {
-                    "agg": agg,
-                    "fault": fault or "none",
-                    "attack": attack or "none",
+    # stdout keeps one JSON object per completed cell (additive v/kind/ts
+    # stamps); --obs-dir tees the same events into an append-safe JSONL
+    sinks = [obs_lib.StdoutSink()]
+    if args.obs_dir:
+        sinks.append(
+            obs_lib.JsonlSink(obs_lib.events_path(args.obs_dir, "fault_matrix"))
+        )
+    sink = obs_lib.MultiSink(sinks) if len(sinks) > 1 else sinks[0]
+    try:
+        grid = run_matrix(
+            aggs,
+            faults,
+            attacks,
+            cfg_kw,
+            on_cell=lambda agg, fault, attack, cell: sink.emit(
+                obs_lib.make_event(
+                    "fault_cell",
+                    agg=agg,
+                    fault=fault or "none",
+                    attack=attack or "none",
                     **cell,
-                }
+                )
             ),
-            flush=True,
-        ),
-    )
+        )
+    finally:
+        sink.close()
     print(markdown_table(grid), file=sys.stderr, flush=True)
     if args.out:
         io_lib.atomic_pickle(

@@ -17,11 +17,11 @@ pickle of the full grid (``--out``) for downstream plotting.
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
+from .. import obs as obs_lib
 from ..cli import add_knob_flags
 from ..fed.config import FedConfig
 from ..fed.train import FedTrainer
@@ -170,6 +170,8 @@ def main(argv=None) -> None:
                          "the mean (+ val_acc_std)")
     add_knob_flags(ap)  # shared with the main CLI (incl. help text)
     ap.add_argument("--out", default=None, help="pickle the grid here")
+    ap.add_argument("--obs-dir", default=None,
+                    help="also append sweep_cell events (JSONL) here")
     args = ap.parse_args(argv)
 
     aggs = [a for a in args.aggs.split(",") if a]
@@ -208,16 +210,27 @@ def main(argv=None) -> None:
         corrupt_mode=args.corrupt_mode,
         corrupt_size=args.corrupt_size,
     )
-    grid = run_sweep(
-        aggs,
-        attacks,
-        cfg_kw,
-        seeds=args.seeds,
-        on_cell=lambda agg, attack, cell: print(
-            json.dumps({"agg": agg, "attack": attack or "none", **cell}),
-            flush=True,
-        ),
-    )
+    # stdout keeps one JSON object per completed cell (the shape scripts
+    # already parse — schema stamps v/kind/ts are additive); --obs-dir tees
+    # the same events into an append-safe JSONL stream
+    sinks = [obs_lib.StdoutSink()]
+    if args.obs_dir:
+        sinks.append(obs_lib.JsonlSink(obs_lib.events_path(args.obs_dir, "sweep")))
+    sink = obs_lib.MultiSink(sinks) if len(sinks) > 1 else sinks[0]
+    try:
+        grid = run_sweep(
+            aggs,
+            attacks,
+            cfg_kw,
+            seeds=args.seeds,
+            on_cell=lambda agg, attack, cell: sink.emit(
+                obs_lib.make_event(
+                    "sweep_cell", agg=agg, attack=attack or "none", **cell
+                )
+            ),
+        )
+    finally:
+        sink.close()
     print(markdown_table(grid), file=sys.stderr, flush=True)
     if args.out:
         io_lib.atomic_pickle(

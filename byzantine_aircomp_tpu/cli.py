@@ -126,6 +126,10 @@ ARG_TO_FIELD = {
     "corrupt_mode": ("corrupt_mode", None),
     "corrupt_size": ("corrupt_size", None),
     "profile_dir": ("profile_dir", None),
+    "obs_dir": ("obs_dir", None),
+    "obs_stdout": ("obs_stdout", None),
+    "log_file": ("log_file", None),
+    "quiet": ("quiet", None),
     "model_parallel": ("model_parallel", None),
     "rounds": ("rounds", None),
     "interval": ("display_interval", None),
@@ -264,6 +268,30 @@ def build_parser() -> argparse.ArgumentParser:
         type=str,
         default="",
         help="write a jax.profiler trace of the run here",
+    )
+    # observability (docs/OBSERVABILITY.md) — output-only knobs: never part
+    # of the run title or config hash, no effect on the trained program
+    p.add_argument(
+        "--obs-dir",
+        type=str,
+        default="",
+        help="write the schema-versioned per-round event stream (JSONL) here",
+    )
+    p.add_argument(
+        "--obs-stdout",
+        action="store_true",
+        help="also emit structured events as JSON lines on stdout",
+    )
+    p.add_argument(
+        "--log-file",
+        type=str,
+        default="",
+        help="tee harness log lines to this file (append, flushed per line)",
+    )
+    p.add_argument(
+        "--quiet",
+        action="store_true",
+        help="suppress harness log lines on stdout (file tee still written)",
     )
     p.add_argument(
         "--preset",

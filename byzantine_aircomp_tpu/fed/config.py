@@ -225,6 +225,22 @@ class FedConfig:
     # (client_local_step / message_attack / channel / aggregate)
     profile_dir: str = ""
 
+    # observability (obs/): structured telemetry knobs.  All output-only —
+    # they relocate/duplicate what the run reports without touching the
+    # trajectory, so they are excluded from config_hash (like cache_dir)
+    # and never reach run_title.  With all four at defaults no obs code
+    # runs and the pickled record/RNG stream are bit-identical to a build
+    # without the subsystem.
+    # directory for the per-run schema-versioned event stream
+    # ({ckpt_title}.events.jsonl, appended on resume)
+    obs_dir: str = ""
+    # also emit the event stream as JSON lines on stdout
+    obs_stdout: bool = False
+    # tee every harness log line (and the banner) here, flushed per line
+    log_file: str = ""
+    # silence the harness's stdout logging (the log_file tee still writes)
+    quiet: bool = False
+
     @property
     def node_size(self) -> int:
         return self.honest_size + self.byz_size

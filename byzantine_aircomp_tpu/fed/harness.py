@@ -19,19 +19,59 @@ from typing import Dict, Optional
 
 import jax.numpy as jnp
 
+from .. import obs as obs_lib
 from ..data import datasets as data_lib
 from ..utils import io as io_lib
 from . import checkpoint
 from .config import FedConfig
 from .train import FedTrainer
 
+# module-level log routing (configured per-run by ``configure_log``): the
+# optional --log-file tee handle and the --quiet stdout gate.  Module
+# globals — not Logger objects threaded everywhere — because ``log`` is
+# this package's module-level logging function (reproduce.py and friends
+# call ``harness.log`` directly) and every caller must share one routing.
+_LOG_FILE = None
+_QUIET = False
+
+
+def configure_log(log_file: str = "", quiet: bool = False):
+    """Route :func:`log` (and the banner): tee to ``log_file`` (append,
+    flushed per line so a timeout-killed run keeps its tail) and/or
+    silence stdout.  Returns a zero-arg restore callable — callers wrap
+    the run in try/finally so in-process sequential runs (tests, sweeps)
+    never inherit a previous run's routing or leak the file handle."""
+    global _LOG_FILE, _QUIET
+    prev = (_LOG_FILE, _QUIET)
+    _LOG_FILE = io_lib.open_append(log_file) if log_file else None
+    _QUIET = quiet
+
+    def restore():
+        global _LOG_FILE, _QUIET
+        if _LOG_FILE is not None:
+            _LOG_FILE.close()
+        _LOG_FILE, _QUIET = prev
+
+    return restore
+
+
+def _emit_line(line: str):
+    """One log line to the configured outputs, flushed on every line."""
+    if not _QUIET:
+        print(line)
+        sys.stdout.flush()
+    if _LOG_FILE is not None:
+        _LOG_FILE.write(line + "\n")
+        _LOG_FILE.flush()
+
 
 def log(*k, **kw):
-    """Timestamped stdout logging (reference ``log``, ``:40-44``)."""
+    """Timestamped logging (reference ``log``, ``:40-44``), routed through
+    the configured sink: stdout unless ``--quiet``, plus the ``--log-file``
+    tee when set."""
     stamp = time.strftime("[%m-%d %H:%M:%S] ", time.localtime())
-    print(stamp, end="")
-    print(*k, **kw)
-    sys.stdout.flush()
+    sep = kw.get("sep", " ")
+    _emit_line(stamp + sep.join(str(x) for x in k))
 
 
 _CFG_DEFAULTS = {f.name: f.default for f in dataclasses.fields(FedConfig)}
@@ -131,7 +171,13 @@ def config_hash(cfg: FedConfig) -> str:
     """
     import hashlib
 
-    skip = ("checkpoint_dir", "cache_dir", "profile_dir", "inherit", "rounds")
+    skip = (
+        "checkpoint_dir", "cache_dir", "profile_dir", "inherit", "rounds",
+        # observability knobs relocate/duplicate outputs without touching
+        # the trajectory — hashing them would split checkpoint identity
+        # between an observed and an unobserved run of the same config
+        "obs_dir", "obs_stdout", "log_file", "quiet",
+    )
     items = sorted(
         (f.name, repr(getattr(cfg, f.name)))
         for f in dataclasses.fields(cfg)
@@ -165,33 +211,33 @@ def banner(cfg: FedConfig, trainer: FedTrainer, path: str):
         p_str = str(n_params)
     attack_name = cfg.attack if cfg.attack is not None else "baseline"
     ds = trainer.dataset
-    print(f"[submit task ] {path}")
-    print("[running info]")
-    print(f"[network info]   name={cfg.model} parameters number={p_str}")
-    print(
+    _emit_line(f"[submit task ] {path}")
+    _emit_line("[running info]")
+    _emit_line(f"[network info]   name={cfg.model} parameters number={p_str}")
+    _emit_line(
         f"[optimization]   name={cfg.opt} aggregation={cfg.agg} attack={attack_name}"
     )
-    print(
+    _emit_line(
         f"[dataset info] name={ds.name} source={ds.source} "
         f"trainSize={len(ds.x_train)} validationSize={len(ds.x_val)}"
     )
-    print(
+    _emit_line(
         f"[optimizer   ] gamma={cfg.gamma} weight_decay={cfg.weight_decay} "
         f"batchSize={cfg.batch_size}"
     )
-    print(
+    _emit_line(
         f"[node number ]   honestSize={cfg.honest_size}, byzantineSize={cfg.byz_size}"
     )
-    print(
+    _emit_line(
         f"[running time]   rounds={cfg.rounds}, displayInterval={cfg.display_interval}"
     )
     import jax
 
-    print(
+    _emit_line(
         f"[jax set     ]  backend={jax.default_backend()} devices={len(jax.devices())} "
         f"SEED={cfg.seed}, fixSeed={cfg.fix_seed}"
     )
-    print("-------------------------------------------")
+    _emit_line("-------------------------------------------")
 
 
 def _make_trainer(cfg: FedConfig, trainer_cls):
@@ -222,15 +268,32 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
     """Build a trainer, run the full schedule, pickle the record.
 
     Mirrors reference ``run`` (``:427-492``): when no attack is given the
-    Byzantine count is zeroed (``:430-431``)."""
+    Byzantine count is zeroed (``:430-431``).  With ``--obs-dir`` /
+    ``--obs-stdout`` set, a schema-versioned event stream (run_start /
+    span / round / retrace / run_end) is emitted ALONGSIDE — never
+    instead of — the reference-compatible pickled record."""
     if cfg.attack is None:
         cfg.byz_size = 0
     cfg.validate()
 
+    restore_log = configure_log(cfg.log_file, cfg.quiet)
+    obs = obs_lib.from_config(cfg, ckpt_title(cfg))
+    try:
+        return _run_inner(cfg, record_in_file, obs)
+    finally:
+        obs.close()
+        restore_log()
+
+
+def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
+    from ..obs import hbm as hbm_lib
     from ..registry import OPTIMIZERS
 
     trainer_cls = OPTIMIZERS.get(cfg.opt)
-    trainer = _make_trainer(cfg, trainer_cls)
+    with obs.span("setup", stage="trainer_init"):
+        # dataset load + device upload + trainer construction (jit setup
+        # is lazy — compile time lands on the first round's span)
+        trainer = _make_trainer(cfg, trainer_cls)
     path = cache_path(cfg, trainer.dataset.name)
     banner(cfg, trainer, path)
 
@@ -295,22 +358,72 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
                     )
                 log(f"Resumed from checkpoint at round {start_round}")
 
+    import jax
+
+    obs.emit(
+        "run_start",
+        title=run_title(cfg),
+        ckpt_title=title,
+        backend=jax.default_backend(),
+        rounds=cfg.rounds,
+        start_round=start_round,
+        k=cfg.node_size,
+        byz=cfg.byz_size,
+        dim=trainer.dim,
+        agg=cfg.agg,
+        attack=cfg.attack,
+        fault=cfg.fault,
+        seed=cfg.seed,
+        # the same static accounting benchmarks/agg_kernels.py reports, so
+        # the trainer and the microbench can never disagree on HBM math
+        hbm=hbm_lib.aggregator_hbm_model(
+            cfg.agg,
+            cfg.node_size,
+            trainer.dim,
+            impl=getattr(trainer, "_agg_impl", cfg.agg_impl),
+            fused=bool(getattr(trainer, "_fused_epilogue", False)),
+            channel=cfg.noise_var is not None,
+            trim=cfg.byz_size,
+        ),
+    )
     log("Optimization begin")
     t0 = time.perf_counter()
     if cfg.profile_dir:
-        import jax
-
         profile_ctx = jax.profiler.trace(cfg.profile_dir)
         log(f"Profiling to {cfg.profile_dir}")
     else:
         profile_ctx = contextlib.nullcontext()
     with profile_ctx:
         paths = trainer.train(
-            log_fn=log, checkpoint_fn=checkpoint_fn, start_round=start_round
+            log_fn=log, checkpoint_fn=checkpoint_fn, start_round=start_round,
+            obs=obs,
         )
     elapsed = time.perf_counter() - t0
-    rps = (cfg.rounds - start_round) / max(elapsed, 1e-9)
-    log(f"Optimization done in {elapsed:.1f}s ({rps:.2f} rounds/sec)")
+    # rounds/sec only when it means something: a 0-round schedule or a
+    # resume-at-end run divides 0 (or a few microseconds of no-op loop) —
+    # the old banner printed 0.00 or a nonsense multi-thousand rate
+    rounds_run = max(cfg.rounds - start_round, 0)
+    if rounds_run and elapsed > 1e-6:
+        rps = rounds_run / elapsed
+        log(f"Optimization done in {elapsed:.1f}s ({rps:.2f} rounds/sec)")
+    else:
+        rps = None
+        log(f"Optimization done in {elapsed:.1f}s (no rounds run)")
+
+    # retrace audit: the steady-state round fn must have lowered at most
+    # once this run (compile on the first executed round, cache hits after)
+    retrace = getattr(trainer, "retrace", None)
+    if retrace is not None:
+        steady_ok = retrace.check("round_fn", max_lowerings=1, warn_fn=log)
+        obs.emit("retrace", counts=retrace.snapshot(), steady_state_ok=steady_ok)
+    obs.emit(
+        "run_end",
+        elapsed_secs=round(elapsed, 3),
+        rounds_run=rounds_run,
+        rounds_per_sec=None if rps is None else round(rps, 4),
+        final_val_acc=paths["valAccPath"][-1],
+        final_val_loss=paths["valLossPath"][-1],
+    )
 
     record = {
         # dataset config block (reference dataSetConfig, :536-541)

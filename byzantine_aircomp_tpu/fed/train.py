@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from .. import obs as obs_lib
 from ..data import datasets as data_lib
 from ..ops import aggregators as agg_lib
 from ..ops import attacks as attack_lib
@@ -253,17 +254,27 @@ class FedTrainer:
         self._base_key = jax.random.key(cfg.seed, impl=impl)
 
         copts = self._jit_compiler_options()
+        # retrace detector (obs/retrace.py): counts lowerings of the jitted
+        # hot paths.  The counter wrapper sits UNDER jit and is pure Python
+        # bookkeeping — the traced program, RNG stream and outputs are
+        # bit-identical; steady-state enforcement is the harness's/CI's
+        self.retrace = obs_lib.RetraceDetector()
         # arg 3 is the fault state — an empty pytree when faults are off,
         # so its donation slot contributes no buffers to the default program
         self._round_fn = jax.jit(
-            self._build_round_fn(), donate_argnums=(0, 1, 2, 3),
+            self.retrace.wrap("round_fn", self._build_round_fn()),
+            donate_argnums=(0, 1, 2, 3),
             compiler_options=copts,
         )
         self._multi_round_fn = jax.jit(
-            self._build_multi_round_fn(), donate_argnums=(0, 1, 2, 3),
+            self.retrace.wrap("multi_round_fn", self._build_multi_round_fn()),
+            donate_argnums=(0, 1, 2, 3),
             compiler_options=copts,
         )
-        self._eval_fn = jax.jit(self._build_eval_fn(), compiler_options=copts)
+        self._eval_fn = jax.jit(
+            self.retrace.wrap("eval_fn", self._build_eval_fn()),
+            compiler_options=copts,
+        )
         self._eval_cache: Dict[str, Any] = {}
 
     def _jit_compiler_options(self):
@@ -767,14 +778,23 @@ class FedTrainer:
         log_fn: Optional[Callable[[str], None]] = None,
         checkpoint_fn: Optional[Callable[[int, "FedTrainer"], None]] = None,
         start_round: int = 0,
+        obs: Optional["obs_lib.Observability"] = None,
     ) -> Dict[str, List[float]]:
         """Full training run; returns reference-schema metric paths
         (``trainLossPath`` etc., pickled record keys at ``:481-489``).
         ``start_round > 0`` resumes a checkpointed run: per-round keys are
         derived by ``fold_in(seed, round)``, so the remaining rounds replay
-        identically to an uninterrupted run."""
+        identically to an uninterrupted run.  ``obs`` (default: the null
+        sink) receives span timings — compile-round vs steady-state rounds
+        are distinguished by the retrace counter, not by position — and a
+        schema-versioned per-round event mirroring the floats appended to
+        the reference paths.  The observed program is the SAME program: no
+        extra device syncs are introduced (the round span closes over the
+        existing ``block_until_ready``) and eval/checkpoint spans only read
+        the host clock."""
         cfg = self.cfg
         log = log_fn or (lambda s: None)
+        obs = obs or obs_lib.NULL
 
         def eval_pair():
             if cfg.eval_train:
@@ -784,7 +804,8 @@ class FedTrainer:
             va = self.evaluate("val")
             return tr, va
 
-        (tr_loss, tr_acc), (va_loss, va_acc) = eval_pair()
+        with obs.span("eval", stage="initial", round=start_round):
+            (tr_loss, tr_acc), (va_loss, va_acc) = eval_pair()
         paths = {
             "trainLossPath": [tr_loss],
             "trainAccPath": [tr_acc],
@@ -809,11 +830,20 @@ class FedTrainer:
         )
 
         for r in range(start_round, cfg.rounds):
+            lowerings_before = self.retrace.count("round_fn")
             t0 = time.perf_counter()
-            variance = self.run_round(r)
-            jax.block_until_ready(self.flat_params)
+            with obs.span("round", round=r) as sp:
+                variance = self.run_round(r)
+                jax.block_until_ready(self.flat_params)
+                # True exactly when this call traced/compiled (round 0 of a
+                # fresh jit, or a steady-state retrace — which the harness
+                # audit flags) so span timings separate compile from
+                # steady-state without a second warmup pass
+                compiled = self.retrace.count("round_fn") > lowerings_before
+                sp["compiled"] = compiled
             dt = time.perf_counter() - t0
-            (tr_loss, tr_acc), (va_loss, va_acc) = eval_pair()
+            with obs.span("eval", stage="round", round=r + 1):
+                (tr_loss, tr_acc), (va_loss, va_acc) = eval_pair()
             paths["trainLossPath"].append(tr_loss)
             paths["trainAccPath"].append(tr_acc)
             paths["valLossPath"].append(va_loss)
@@ -823,6 +853,7 @@ class FedTrainer:
             var_str = (
                 f" var={cfg.noise_var:.2e}" if cfg.noise_var is not None else ""
             )
+            fault_metrics = None
             if self.fault is not None:
                 dropped, erased, corrupt, eff_k = (
                     float(v) for v in np.asarray(self.last_fault_metrics)
@@ -831,17 +862,36 @@ class FedTrainer:
                 paths["faultErasedPath"].append(erased)
                 paths["faultCorruptPath"].append(corrupt)
                 paths["effectiveKPath"].append(eff_k)
+                fault_metrics = {
+                    "dropped": dropped,
+                    "erased": erased,
+                    "corrupt": corrupt,
+                    "effective_k": eff_k,
+                }
                 var_str += (
                     f" effK={eff_k:.0f} drop={dropped:.0f} "
                     f"erase={erased:.0f} corrupt={corrupt:.0f}"
                 )
+            obs.round(
+                r,
+                train_loss=tr_loss,
+                train_acc=tr_acc,
+                val_loss=va_loss,
+                val_acc=va_acc,
+                variance=float(variance),
+                round_secs=dt,
+                rounds_per_sec=1.0 / dt,
+                compiled=compiled,
+                fault_metrics=fault_metrics,
+            )
             log(
                 f"[{r + 1}/{cfg.rounds}](interval: {cfg.display_interval}) "
                 f"train: loss={tr_loss:.4f} acc={tr_acc:.4f} "
                 f"val: loss={va_loss:.4f} acc={va_acc:.4f}{var_str}"
             )
             if checkpoint_fn is not None:
-                checkpoint_fn(r + 1, self)
+                with obs.span("checkpoint", round=r + 1):
+                    checkpoint_fn(r + 1, self)
         return paths
 
     @property

@@ -1,0 +1,89 @@
+"""Observability: structured telemetry across the AirComp stack.
+
+One subsystem replaces the scattered per-tool emission the repo grew —
+pickled path lists here, hand-rolled JSONL there, ad-hoc stdout ``log()``
+lines everywhere:
+
+* :mod:`.sinks`   — where events go (JSONL file / stdout / memory / fan-out)
+* :mod:`.events`  — the schema-versioned event shapes + the reference-record
+  field mapping
+* :mod:`.span`    — phase timing (compile vs steady-state, eval, checkpoint)
+* :mod:`.retrace` — lowering counters that catch steady-state recompilation
+* :mod:`.hbm`     — static HBM-traffic models shared by benchmarks and trainer
+
+:class:`Observability` is the façade the harness/trainer thread through:
+``obs.span(...)`` / ``obs.round(...)`` / ``obs.emit(...)``.  The disabled
+path is :data:`NULL` (a null sink) — with ``--obs-dir``/``--obs-stdout``
+unset no file is touched, no event is built beyond a dict that is
+immediately dropped, and the training program (trace, RNG stream, pickled
+record) is bit-identical to a build without this package.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .events import (  # noqa: F401
+    REFERENCE_KEY_MAP,
+    SCHEMA_VERSION,
+    Collector,
+    make_event,
+    validate_event,
+)
+from .retrace import RetraceDetector, RetraceError  # noqa: F401
+from .sinks import (  # noqa: F401
+    EventSink,
+    JsonlSink,
+    MemorySink,
+    MultiSink,
+    NullSink,
+    StdoutSink,
+)
+from .span import SpanTimer
+
+
+class Observability:
+    """Façade bundling a sink with the span timer and round collector."""
+
+    def __init__(self, sink: EventSink) -> None:
+        self.sink = sink
+        self.enabled = not isinstance(sink, NullSink)
+        self._spans = SpanTimer(sink)
+        self.collector = Collector(sink)
+
+    def emit(self, kind: str, **fields) -> None:
+        self.sink.emit(make_event(kind, **fields))
+
+    def span(self, name: str, sync=None, **fields):
+        return self._spans.span(name, sync=sync, **fields)
+
+    def round(self, round_idx: int, **metrics) -> None:
+        self.collector.round_event(round_idx, **metrics)
+
+    def close(self) -> None:
+        self.sink.close()
+
+
+#: the disabled singleton — shared, stateless, close() is a no-op
+NULL = Observability(NullSink())
+
+
+def events_path(obs_dir: str, title: str) -> str:
+    """The per-run event-stream file: keyed on the ckpt title (run title +
+    config hash) so a resumed run APPENDS to its own stream and two
+    different configs can never interleave one file."""
+    return os.path.join(obs_dir, f"{title}.events.jsonl")
+
+
+def from_config(cfg, title: str) -> Observability:
+    """Build the configured Observability for a run (``NULL`` when both
+    ``obs_dir`` and ``obs_stdout`` are unset)."""
+    sinks = []
+    if getattr(cfg, "obs_dir", ""):
+        sinks.append(JsonlSink(events_path(cfg.obs_dir, title)))
+    if getattr(cfg, "obs_stdout", False):
+        sinks.append(StdoutSink())
+    if not sinks:
+        return NULL
+    return Observability(sinks[0] if len(sinks) == 1 else MultiSink(sinks))

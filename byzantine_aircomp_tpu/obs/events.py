@@ -1,0 +1,126 @@
+"""Event schema: one versioned shape for every runner's telemetry.
+
+Before this module each tool invented its own JSON: the harness pickled
+reference-format path lists, the sweep printed ad-hoc cell rows, and each
+benchmark hand-rolled its emission.  Now every event is a flat JSON object
+with three reserved keys —
+
+* ``v``    — integer schema version (:data:`SCHEMA_VERSION`), bumped on any
+  breaking field change so downstream loaders can dispatch;
+* ``kind`` — the event type (``run_start``, ``round``, ``span``, ``retrace``,
+  ``run_end``, ``bench``, ``sweep_cell``, ``fault_cell``, ...);
+* ``ts``   — wall-clock epoch seconds at emission (ordering / gap analysis;
+  NEVER used for metrics — durations come from span events).
+
+The per-round ``round`` event mirrors — field for field — the reference
+pickled record the harness still writes (bitwise untouched; the event
+stream is written ALONGSIDE it).  :data:`REFERENCE_KEY_MAP` is the
+machine-readable statement of that mapping, including the intentional
+``variencePath`` spelling the reference's draw.ipynb consumes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+SCHEMA_VERSION = 1
+
+# round-event field -> reference pickled-record key it mirrors
+# (round r's event carries metrics the record stores at index r+1 for the
+# eval paths — index 0 is the pre-training eval — and index r for the
+# per-round paths; see docs/OBSERVABILITY.md)
+REFERENCE_KEY_MAP = {
+    "train_loss": "trainLossPath",
+    "train_acc": "trainAccPath",
+    "val_loss": "valLossPath",
+    "val_acc": "valAccPath",
+    "variance": "variencePath",  # sic — reference spelling, kept verbatim
+    "rounds_per_sec": "roundsPerSec",
+    "dropped": "faultDroppedPath",
+    "erased": "faultErasedPath",
+    "corrupt": "faultCorruptPath",
+    "effective_k": "effectiveKPath",
+}
+
+# per-kind required fields (beyond the reserved v/kind/ts trio); kinds not
+# listed here are free-form carriers (bench rows keep their historical keys)
+_REQUIRED: Dict[str, tuple] = {
+    "run_start": ("title", "backend", "rounds", "start_round"),
+    "round": ("round", "val_loss", "val_acc", "variance"),
+    "span": ("name", "ms"),
+    "retrace": ("counts", "steady_state_ok"),
+    "run_end": ("elapsed_secs", "rounds_run"),
+}
+
+
+def make_event(kind: str, **fields: Any) -> Dict[str, Any]:
+    """Stamp ``fields`` into a schema-versioned event dict."""
+    event: Dict[str, Any] = {"v": SCHEMA_VERSION, "kind": kind, "ts": time.time()}
+    event.update(fields)
+    return event
+
+
+def validate_event(event: Dict[str, Any]) -> Dict[str, Any]:
+    """Raise ``ValueError`` unless ``event`` is schema-valid; returns it."""
+    for key in ("v", "kind", "ts"):
+        if key not in event:
+            raise ValueError(f"event missing reserved key {key!r}: {event}")
+    if event["v"] != SCHEMA_VERSION:
+        raise ValueError(
+            f"schema version {event['v']} != {SCHEMA_VERSION}: {event}"
+        )
+    missing = [
+        k for k in _REQUIRED.get(event["kind"], ()) if k not in event
+    ]
+    if missing:
+        raise ValueError(
+            f"{event['kind']} event missing fields {missing}: {event}"
+        )
+    return event
+
+
+class Collector:
+    """Turns the trainer's per-round metrics (the jitted round's
+    ``RoundMetrics`` scalars plus the fault counters) into ``round``
+    events on a sink.
+
+    The trainer hands over exactly what it appends to the
+    reference-compatible path lists, so the two streams cannot drift:
+    one code path computes the numbers, the collector only reshapes.
+    """
+
+    def __init__(self, sink) -> None:
+        self._sink = sink
+
+    def round_event(
+        self,
+        round_idx: int,
+        *,
+        train_loss: float,
+        train_acc: float,
+        val_loss: float,
+        val_acc: float,
+        variance: float,
+        round_secs: Optional[float] = None,
+        rounds_per_sec: Optional[float] = None,
+        compiled: Optional[bool] = None,
+        fault_metrics: Optional[Dict[str, float]] = None,
+    ) -> None:
+        fields: Dict[str, Any] = dict(
+            round=round_idx,
+            train_loss=train_loss,
+            train_acc=train_acc,
+            val_loss=val_loss,
+            val_acc=val_acc,
+            variance=variance,
+        )
+        if round_secs is not None:
+            fields["round_secs"] = round_secs
+        if rounds_per_sec is not None:
+            fields["rounds_per_sec"] = rounds_per_sec
+        if compiled is not None:
+            fields["compiled"] = compiled
+        if fault_metrics:
+            fields.update(fault_metrics)
+        self._sink.emit(make_event("round", **fields))

@@ -1,0 +1,102 @@
+"""Static HBM-traffic models, shared by benchmarks and the trainer.
+
+The analytic per-aggregation byte model used to live inside
+``benchmarks/agg_kernels.py``, so the microbench and the training harness
+could silently disagree about what "single HBM pass" means.  It now lives
+here: the benchmark imports :func:`epilogue_hbm_bytes` and the harness
+reports the same accounting in its ``run_start`` event, so a regression
+in either surface shows up against one model.
+
+The models are STATIC — derived from shapes and the documented access
+patterns (docs/DESIGN.md's epilogue section), not measured.  The
+compile-time measured counterpart is ``benchmarks/hbm_compile.py``
+(XLA's ``memory_analysis``), which answers the peak-allocation question;
+this module answers the traffic question.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+#: aggregators whose epilogue is the sort-family selection the fused paths
+#: realize (ops/aggregators.py dispatch)
+SORT_FAMILY = ("median", "trimmed_mean")
+
+
+def stack_bytes(k: int, d: int, dtype_bytes: int = 4) -> int:
+    """Bytes of one [K, d] client stack."""
+    return k * d * dtype_bytes
+
+
+def epilogue_hbm_bytes(
+    impl: str, k: int, d: int, b: int, channel: bool
+) -> int:
+    """Analytic HBM bytes per sort-family aggregation epilogue (f32).
+
+    ``impl`` is one of ``sort`` (full XLA bitonic sort — a LOWER bound of
+    3 stack-sized round trips), ``select`` (XLA key bisection: 32 cheap
+    counting passes over int32 keys + one value pass), or ``pallas`` (the
+    single-HBM-pass peel kernel: each padded tile is DMA'd into VMEM
+    exactly once).  ``channel`` adds the OMA terms: the [K, d] noise pair
+    folded into the fused reads, or the standalone read-modify-write pass
+    the sort path pays first.
+    """
+    stack = k * d * 4
+    out = d * 4
+    if impl == "pallas":
+        kp, dp = -(-k // 8) * 8, -(-d // 128) * 128
+        tiles = (kp * dp * 4) * (3 if channel else 1)  # w (+ n_r, n_i)
+        return tiles + out
+    if impl == "select":
+        # keys materialize once (stack read), 32 bisection count passes
+        # re-read them, one final masked-sum pass reads values
+        core = stack * 34
+        if channel:
+            core += 3 * stack  # n_r + n_i reads, post-channel stack write
+        return core + out
+    if impl == "sort":
+        # sort: LOWER bound — read stack, write sorted, re-read kept band
+        core = 3 * stack
+        if channel:
+            core += 4 * stack  # standalone OMA pass: read w, n_r, n_i, write
+        return core + out
+    raise ValueError(f"unknown epilogue impl {impl!r}")
+
+
+def aggregator_hbm_model(
+    agg: str,
+    k: int,
+    d: int,
+    *,
+    impl: str = "xla",
+    fused: bool = False,
+    channel: bool = False,
+    trim: int = 0,
+) -> Dict[str, Any]:
+    """Per-round aggregation HBM accounting for the harness's run_start
+    event.  Sort-family aggregators get the full epilogue model under the
+    realization the trainer actually resolved (``fused`` + ``impl``);
+    iterative aggregators (gm & co. re-read the stack once per Weiszfeld
+    step — iteration count is data-dependent) report the per-iteration
+    stack read and a null total."""
+    sb = stack_bytes(k, d)
+    if agg in SORT_FAMILY:
+        impl_name = (
+            ("pallas" if impl == "pallas" else "select") if fused else "sort"
+        )
+        hbm = epilogue_hbm_bytes(impl_name, k, d, trim, channel)
+        return {
+            "agg": agg,
+            "impl": impl_name,
+            "stack_bytes": sb,
+            "hbm_bytes": hbm,
+            "hbm_x": round(hbm / sb, 3),
+        }
+    return {
+        "agg": agg,
+        "impl": impl,
+        "stack_bytes": sb,
+        "hbm_bytes": None,
+        "hbm_x": None,
+        "bytes_per_weiszfeld_iter": sb,
+    }

@@ -1,0 +1,74 @@
+"""Retrace detector: count lowerings of the jitted hot-path functions.
+
+A steady-state federated run compiles the round program ONCE and then
+re-dispatches it; any further lowering means a shape / dtype / static-arg
+leak re-entered the compiler mid-run — the classic silent 100x
+regression.  ``jax.jit`` re-executes the wrapped Python callable exactly
+when it traces, so a plain Python counter wrapped UNDER the jit boundary
+counts lowerings with zero effect on the traced program (the wrapper is
+invisible to XLA: same jaxpr, same RNG stream, same outputs).
+
+``FedTrainer`` wraps its round / multi-round / eval functions through one
+detector unconditionally (the counter is two dict ops per trace);
+enforcement is opt-in via :meth:`check` — the harness warns, CI raises.
+Eval legitimately lowers once per distinct split shape (train vs val
+chunk counts differ), so the steady-state gate applies to the round
+functions only.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+
+class RetraceError(RuntimeError):
+    """Raised by :meth:`RetraceDetector.check` in ``error`` mode."""
+
+
+class RetraceDetector:
+    def __init__(self) -> None:
+        self.counts: Dict[str, int] = {}
+
+    def wrap(self, name: str, fn: Callable) -> Callable:
+        """Wrap ``fn`` (BEFORE jit) so each trace increments ``counts[name]``."""
+        self.counts.setdefault(name, 0)
+
+        @functools.wraps(fn)
+        def traced(*args, **kwargs):
+            self.counts[name] += 1
+            return fn(*args, **kwargs)
+
+        return traced
+
+    def count(self, name: str) -> int:
+        return self.counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        return dict(self.counts)
+
+    def check(
+        self,
+        name: str,
+        max_lowerings: int = 1,
+        error: bool = False,
+        warn_fn: Optional[Callable[[str], None]] = None,
+    ) -> bool:
+        """True iff ``name`` lowered at most ``max_lowerings`` times.
+
+        On violation: raises :class:`RetraceError` when ``error``,
+        otherwise calls ``warn_fn`` (if given) with a diagnostic line.
+        """
+        n = self.count(name)
+        ok = n <= max_lowerings
+        if not ok:
+            msg = (
+                f"steady-state retracing: {name} lowered {n}x "
+                f"(expected <= {max_lowerings}) — a shape/dtype/static-arg "
+                "leak is re-entering the compiler mid-run"
+            )
+            if error:
+                raise RetraceError(msg)
+            if warn_fn is not None:
+                warn_fn(f"WARNING: {msg}")
+        return ok

@@ -1,0 +1,49 @@
+"""``span()`` — wall-clock timing of named phases, emitted as events.
+
+The training loop's phases (compile vs steady-state rounds, eval,
+checkpointing, setup/data loading) each get a ``span`` event with a
+monotonic-clock duration.  JAX dispatch is asynchronous, so a span that
+should charge device work to itself must end on a
+``jax.block_until_ready`` barrier — pass the arrays (or a thunk
+returning them) as ``sync=``; spans around host-side work omit it and
+cost two clock reads.
+
+The context manager yields a mutable dict: fields set on it inside the
+body land on the emitted event (e.g. the round span's ``compiled`` flag,
+known only after the body has run).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, Optional
+
+from .events import make_event
+
+
+class SpanTimer:
+    def __init__(self, sink) -> None:
+        self._sink = sink
+
+    @contextlib.contextmanager
+    def span(
+        self, name: str, sync: Optional[Any] = None, **fields: Any
+    ) -> Iterator[Dict[str, Any]]:
+        extra: Dict[str, Any] = dict(fields)
+        t0 = time.perf_counter()
+        try:
+            yield extra
+            if sync is not None:
+                import jax
+
+                jax.block_until_ready(sync() if callable(sync) else sync)
+        except BaseException:
+            # a span interrupted by an exception still reports, flagged —
+            # the tail of a crashed run is exactly when timing data matters
+            extra.setdefault("error", True)
+            ms = (time.perf_counter() - t0) * 1e3
+            self._sink.emit(make_event("span", name=name, ms=round(ms, 3), **extra))
+            raise
+        ms = (time.perf_counter() - t0) * 1e3
+        self._sink.emit(make_event("span", name=name, ms=round(ms, 3), **extra))

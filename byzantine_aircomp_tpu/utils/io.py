@@ -40,3 +40,17 @@ def atomic_write(path: str, write_fn: Callable, mode: str = "wb") -> str:
 def atomic_pickle(path: str, obj: Any) -> str:
     """Atomically pickle ``obj`` to ``path``."""
     return atomic_write(path, lambda f: pickle.dump(obj, f))
+
+
+def open_append(path: str):
+    """Open ``path`` for line-buffered text append, creating parent dirs.
+
+    The append-safe counterpart to :func:`atomic_write` for GROWING
+    artifacts (event streams, log tees) where replace-on-close would
+    discard the tail a killed run already paid for.  Line buffering plus
+    one-line-per-write() callers means a kill never tears a line and
+    POSIX append semantics keep concurrent writers from interleaving
+    within one."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    return open(path, "a", buffering=1)

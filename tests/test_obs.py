@@ -1,0 +1,395 @@
+"""Observability subsystem: sinks, spans, retrace detection, HBM model,
+and the harness/trainer event stream.
+
+The acceptance bar (ISSUE): with ``--obs-dir`` unset the trained program
+and the pickled record are untouched; with it set, a short MNIST CPU run
+emits a schema-valid per-round JSONL stream whose timings separate compile
+from steady state and whose retrace audit records EXACTLY ONE lowering of
+the round fn.  The ``retrace``/``lowering`` tests double as the CI gate
+(``-k "retrace or lowering"``).
+"""
+
+import json
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu import obs as obs_lib
+from byzantine_aircomp_tpu.obs import hbm as hbm_lib
+from byzantine_aircomp_tpu.fed.config import FedConfig
+
+
+# ---------------------------------------------------------------- sinks
+
+
+def test_memory_sink_collects_and_filters():
+    s = obs_lib.MemorySink()
+    s.emit(obs_lib.make_event("a", x=1))
+    s.emit(obs_lib.make_event("b", x=2))
+    s.emit(obs_lib.make_event("a", x=3))
+    assert [e["x"] for e in s.by_kind("a")] == [1, 3]
+    assert len(s.events) == 3
+
+
+def test_jsonl_sink_appends_and_flushes_per_line(tmp_path):
+    p = str(tmp_path / "ev.jsonl")
+    sink = obs_lib.JsonlSink(p)
+    assert sink.fresh
+    sink.emit(obs_lib.make_event("a", x=1))
+    # flushed per event: the line is durable BEFORE close (kill-safety)
+    assert json.loads(open(p).read().strip())["x"] == 1
+    sink.emit(obs_lib.make_event("a", x=2))
+    sink.close()
+    # a second sink on the same path appends (resume semantics) and is
+    # not fresh
+    sink2 = obs_lib.JsonlSink(p)
+    assert not sink2.fresh
+    sink2.emit(obs_lib.make_event("a", x=3))
+    sink2.close()
+    xs = [json.loads(l)["x"] for l in open(p)]
+    assert xs == [1, 2, 3]
+
+
+def test_jsonl_sink_atomic_writes_only_at_close(tmp_path):
+    import os
+
+    p = str(tmp_path / "atomic.jsonl")
+    sink = obs_lib.JsonlSink(p, atomic=True)
+    sink.emit(obs_lib.make_event("a", x=1))
+    assert not os.path.exists(p)  # nothing until close
+    sink.close()
+    assert [json.loads(l)["x"] for l in open(p)] == [1]
+
+
+def test_multi_sink_fans_out(tmp_path):
+    mem = obs_lib.MemorySink()
+    p = str(tmp_path / "fan.jsonl")
+    multi = obs_lib.MultiSink([mem, obs_lib.JsonlSink(p)])
+    multi.emit(obs_lib.make_event("a", x=7))
+    multi.close()
+    assert mem.events[0]["x"] == 7
+    assert json.loads(open(p).read())["x"] == 7
+
+
+def test_stdout_sink_json_lines(capsys):
+    obs_lib.StdoutSink().emit(obs_lib.make_event("a", x=1))
+    row = json.loads(capsys.readouterr().out.strip())
+    assert row["kind"] == "a" and row["x"] == 1 and row["v"] == 1
+
+
+# ------------------------------------------------------------ schema
+
+
+def test_validate_event_catches_bad_events():
+    ok = obs_lib.make_event("round", round=0, val_loss=1.0, val_acc=0.1,
+                            variance=0.0)
+    assert obs_lib.validate_event(ok) is ok
+    with pytest.raises(ValueError):
+        obs_lib.validate_event({"kind": "round"})  # missing v/ts
+    with pytest.raises(ValueError):
+        obs_lib.validate_event(obs_lib.make_event("round", round=0))  # fields
+    bad_v = obs_lib.make_event("span", name="x", ms=1.0)
+    bad_v["v"] = 999
+    with pytest.raises(ValueError):
+        obs_lib.validate_event(bad_v)
+
+
+def test_reference_key_map_keeps_varience_spelling():
+    # the reference record's intentional misspelling is load-bearing
+    # (draw.ipynb consumes it); the map is the machine-readable contract
+    assert obs_lib.REFERENCE_KEY_MAP["variance"] == "variencePath"
+
+
+# ------------------------------------------------------------- spans
+
+
+def test_span_emits_duration_and_body_fields():
+    mem = obs_lib.MemorySink()
+    timer = obs_lib.Observability(mem)
+    with timer.span("work", stage="test") as sp:
+        sp["extra"] = 42
+    (ev,) = mem.by_kind("span")
+    assert ev["name"] == "work" and ev["stage"] == "test"
+    assert ev["extra"] == 42 and ev["ms"] >= 0
+
+
+def test_span_reports_on_exception():
+    mem = obs_lib.MemorySink()
+    timer = obs_lib.Observability(mem)
+    with pytest.raises(RuntimeError):
+        with timer.span("doomed"):
+            raise RuntimeError("boom")
+    (ev,) = mem.by_kind("span")
+    assert ev["error"] is True and ev["ms"] >= 0
+
+
+# ----------------------------------------------------------- retrace
+
+
+def test_retrace_detector_counts_lowerings_per_shape():
+    det = obs_lib.RetraceDetector()
+    f = jax.jit(det.wrap("f", lambda x: x * 2))
+    f(jnp.zeros(4))
+    f(jnp.ones(4))  # cache hit: same shape
+    assert det.count("f") == 1
+    f(jnp.zeros(8))  # new shape: re-lowers
+    assert det.count("f") == 2
+    assert det.snapshot() == {"f": 2}
+
+
+def test_retrace_check_warns_and_raises():
+    det = obs_lib.RetraceDetector()
+    f = jax.jit(det.wrap("f", lambda x: x + 1))
+    f(jnp.zeros(2))
+    f(jnp.zeros(3))
+    warnings = []
+    assert not det.check("f", max_lowerings=1, warn_fn=warnings.append)
+    assert warnings and "retracing" in warnings[0]
+    with pytest.raises(obs_lib.RetraceError):
+        det.check("f", max_lowerings=1, error=True)
+    assert det.check("f", max_lowerings=2)
+
+
+def test_retrace_wrapper_preserves_jit_outputs():
+    det = obs_lib.RetraceDetector()
+    fn = lambda x: jnp.sin(x) * 3
+    plain = jax.jit(fn)(jnp.linspace(0, 1, 16))
+    wrapped = jax.jit(det.wrap("f", fn))(jnp.linspace(0, 1, 16))
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(wrapped))
+
+
+# --------------------------------------------------------- HBM model
+
+
+def test_hbm_model_shared_with_benchmark():
+    # benchmarks/agg_kernels.py must alias obs/hbm.py's model, not carry
+    # its own copy — the dedup the ISSUE requires
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "agg_kernels_bench", os.path.join(repo, "benchmarks", "agg_kernels.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.hbm_model is hbm_lib.epilogue_hbm_bytes
+
+
+def test_hbm_model_shapes():
+    k, d = 64, 512
+    sb = hbm_lib.stack_bytes(k, d)
+    assert sb == k * d * 4
+    # pallas: ~one stack pass; sort: >= 3 stack passes
+    assert hbm_lib.epilogue_hbm_bytes("pallas", k, d, 4, False) <= 1.1 * sb
+    assert hbm_lib.epilogue_hbm_bytes("sort", k, d, 4, False) >= 3 * sb
+    with pytest.raises(ValueError):
+        hbm_lib.epilogue_hbm_bytes("nope", k, d, 4, False)
+    m = hbm_lib.aggregator_hbm_model("trimmed_mean", k, d, fused=True,
+                                     impl="pallas", trim=4)
+    assert m["impl"] == "pallas" and m["hbm_bytes"] is not None
+    gm = hbm_lib.aggregator_hbm_model("gm2", k, d)
+    assert gm["hbm_bytes"] is None and gm["bytes_per_weiszfeld_iter"] == sb
+
+
+# ----------------------------------------------- end-to-end harness runs
+
+
+def _cfg(rounds, **kw):
+    base = dict(
+        dataset="mnist", honest_size=6, byz_size=0, rounds=rounds,
+        display_interval=3, batch_size=16, agg="mean", eval_train=False,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+@pytest.fixture
+def synthetic_mnist(monkeypatch):
+    import byzantine_aircomp_tpu.data.datasets as dl
+
+    orig = dl.load
+    monkeypatch.setattr(
+        dl, "load",
+        lambda name, **kw: orig(name, synthetic_train=1500, synthetic_val=300),
+    )
+
+
+def _read_events(obs_dir, cfg):
+    from byzantine_aircomp_tpu.fed import harness
+
+    path = obs_lib.events_path(str(obs_dir), harness.ckpt_title(cfg))
+    return [json.loads(l) for l in open(path)]
+
+
+def test_three_round_run_emits_valid_stream_single_lowering(
+    tmp_path, synthetic_mnist
+):
+    from byzantine_aircomp_tpu.fed import harness
+
+    cfg = _cfg(3, obs_dir=str(tmp_path / "obs"))
+    harness.run(cfg, record_in_file=False)
+    events = _read_events(tmp_path / "obs", cfg)
+    for e in events:
+        obs_lib.validate_event(e)
+    kinds = [e["kind"] for e in events]
+    for k in ("run_start", "span", "round", "retrace", "run_end"):
+        assert k in kinds
+    # run metadata precedes the first round event (only the setup span
+    # can legitimately land before it); the summary closes the stream
+    assert kinds.index("run_start") < kinds.index("round")
+    assert kinds[-1] == "run_end"
+    rounds = [e for e in events if e["kind"] == "round"]
+    assert [e["round"] for e in rounds] == [0, 1, 2]
+    # compile vs steady state: round 0 traced, later rounds reused it
+    assert [e["compiled"] for e in rounds] == [True, False, False]
+    span_names = {e["name"] for e in events if e["kind"] == "span"}
+    assert {"setup", "round", "eval"} <= span_names
+    (ret,) = [e for e in events if e["kind"] == "retrace"]
+    assert ret["counts"]["round_fn"] == 1 and ret["steady_state_ok"]
+    (start,) = [e for e in events if e["kind"] == "run_start"]
+    assert start["hbm"]["stack_bytes"] == 6 * start["dim"] * 4
+    (end,) = [e for e in events if e["kind"] == "run_end"]
+    assert end["rounds_run"] == 3 and end["rounds_per_sec"] > 0
+
+
+def test_obs_off_record_bitwise_identical(tmp_path, synthetic_mnist):
+    from byzantine_aircomp_tpu.fed import harness
+
+    plain = harness.run(_cfg(3), record_in_file=False)
+    observed = harness.run(
+        _cfg(3, obs_dir=str(tmp_path / "obs")), record_in_file=False
+    )
+    # roundsPerSec is wall clock — nondeterministic between ANY two runs
+    plain.pop("roundsPerSec")
+    observed.pop("roundsPerSec")
+    assert pickle.dumps(plain) == pickle.dumps(observed)
+
+
+def test_resume_appends_and_continues_round_indices(tmp_path, synthetic_mnist):
+    from byzantine_aircomp_tpu.fed import harness
+
+    def cfg(rounds, inherit=False):
+        return _cfg(
+            rounds,
+            inherit=inherit,
+            obs_dir=str(tmp_path / "obs"),
+            checkpoint_dir=str(tmp_path / "ck") + "/",
+            cache_dir=str(tmp_path / "c") + "/",
+        )
+
+    full = harness.run(_cfg(4), record_in_file=False)
+    harness.run(cfg(2), record_in_file=False)
+    harness.run(cfg(4, inherit=True), record_in_file=False)
+    events = _read_events(tmp_path / "obs", cfg(4))
+    # both run segments landed in ONE stream (same ckpt_title key): the
+    # resumed run CONTINUED the round indices rather than restarting
+    assert [e["kind"] for e in events].count("run_start") == 2
+    starts = [e for e in events if e["kind"] == "run_start"]
+    assert [s["start_round"] for s in starts] == [0, 2]
+    rounds = [e for e in events if e["kind"] == "round"]
+    assert [e["round"] for e in rounds] == [0, 1, 2, 3]
+    # the concatenated telemetry equals the uninterrupted run's record
+    np.testing.assert_allclose(
+        [e["variance"] for e in rounds], full["variencePath"], atol=1e-6
+    )
+    np.testing.assert_allclose(
+        [e["val_loss"] for e in rounds], full["valLossPath"][1:], atol=1e-6
+    )
+    np.testing.assert_allclose(
+        [e["val_acc"] for e in rounds], full["valAccPath"][1:], atol=1e-6
+    )
+
+
+def test_resume_at_end_guards_rounds_per_sec(tmp_path, synthetic_mnist,
+                                             capsys):
+    from byzantine_aircomp_tpu.fed import harness
+
+    def cfg(inherit=False):
+        return _cfg(
+            2,
+            inherit=inherit,
+            obs_dir=str(tmp_path / "obs"),
+            checkpoint_dir=str(tmp_path / "ck") + "/",
+            cache_dir=str(tmp_path / "c") + "/",
+        )
+
+    harness.run(cfg(), record_in_file=False)
+    capsys.readouterr()
+    # resuming a completed run: start_round == rounds, ZERO rounds execute
+    harness.run(cfg(inherit=True), record_in_file=False)
+    out = capsys.readouterr().out
+    assert "no rounds run" in out
+    assert "inf rounds/sec" not in out
+    ends = [e for e in _read_events(tmp_path / "obs", cfg())
+            if e["kind"] == "run_end"]
+    assert ends[-1]["rounds_run"] == 0
+    assert ends[-1]["rounds_per_sec"] is None
+
+
+def test_zero_round_run_guards_rounds_per_sec(synthetic_mnist, capsys):
+    from byzantine_aircomp_tpu.fed import harness
+
+    harness.run(_cfg(0), record_in_file=False)
+    out = capsys.readouterr().out
+    assert "no rounds run" in out and "inf rounds/sec" not in out
+
+
+# --------------------------------------------------------- log routing
+
+
+def test_log_file_tee_and_quiet(tmp_path, synthetic_mnist, capsys):
+    from byzantine_aircomp_tpu.fed import harness
+
+    log_path = str(tmp_path / "run.log")
+    harness.run(
+        _cfg(1, log_file=log_path, quiet=True), record_in_file=False
+    )
+    # quiet: nothing on stdout; tee: the full log (banner + stamped
+    # lines) is in the file, flushed
+    assert capsys.readouterr().out == ""
+    text = open(log_path).read()
+    assert "Optimization begin" in text
+    assert "[running info]" in text
+    assert "[1/1]" in text
+
+
+def test_log_restored_after_run(tmp_path, synthetic_mnist, capsys):
+    from byzantine_aircomp_tpu.fed import harness
+
+    harness.run(_cfg(0, quiet=True), record_in_file=False)
+    # module-level routing is restored: a later direct log() prints again
+    capsys.readouterr()
+    harness.log("hello-after-run")
+    assert "hello-after-run" in capsys.readouterr().out
+
+
+# --------------------------------------------------------------- CLI
+
+
+def test_cli_obs_flags_parse():
+    from byzantine_aircomp_tpu import cli
+
+    p = cli.build_parser()
+    args = p.parse_args(
+        ["--obs-dir", "/tmp/o", "--obs-stdout", "--log-file", "/tmp/l",
+         "--quiet"]
+    )
+    cfg = cli.config_from_args(args)
+    assert cfg.obs_dir == "/tmp/o" and cfg.obs_stdout
+    assert cfg.log_file == "/tmp/l" and cfg.quiet
+
+
+def test_obs_knobs_do_not_change_config_hash(tmp_path):
+    from byzantine_aircomp_tpu.fed import harness
+
+    a = harness.config_hash(_cfg(3))
+    b = harness.config_hash(
+        _cfg(3, obs_dir="/tmp/x", obs_stdout=True, log_file="/tmp/l",
+             quiet=True)
+    )
+    # output-only knobs must not split checkpoint identity
+    assert a == b
