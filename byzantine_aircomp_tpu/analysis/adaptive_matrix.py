@@ -1,0 +1,247 @@
+"""Adaptive-defense matrix: every registered attack against the online
+defense at the stack level.
+
+The fault matrix (:mod:`.fault_matrix`) asks "which aggregator survives
+which failure"; this tool asks the DEFENSE question the escalation ladder
+adds: for each registered attack — switched on mid-run through the
+``name@R`` onset syntax and optionally switched back off — does the
+detector notice (and how fast), does the policy climb the ladder, and does
+it climb back down once the attacker goes quiet?  Cells run the real
+``defense/`` scoring + policy math on a small synthetic stack (the
+``tests/test_defense_matrix.py`` regime: a tight honest cluster one SGD
+step apart), so the whole matrix is seconds, not training runs:
+
+    python -m byzantine_aircomp_tpu.analysis.adaptive_matrix \
+        --modes monitor,adaptive --iters 40 --onset 10 --stop 30
+
+Output: one JSON line per cell on stdout (kind ``adaptive_cell``), a
+markdown table per mode on stderr, and optionally an atomic pickle of the
+grid (``--out``).  Data-level attacks (whose ``apply_message`` leaves the
+stack untouched) are emulated through their gradient scale when they have
+one; pure data-poisoning attacks legitimately show no stack-level anomaly
+and report ``detect_iter = None``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import defense as defense_lib
+from .. import obs as obs_lib
+from ..ops import attacks as attack_lib
+from ..registry import ATTACKS
+from ..utils import io as io_lib
+
+K, B, D = 16, 3, 24
+HONEST = K - B
+
+Cell = Tuple[str, str]  # (attack, mode)
+
+
+def honest_stack(key: Optional[jax.Array] = None):
+    """The shared smoke-stack fixture (also imported by
+    ``tests/test_defense_matrix.py``): a tight honest cluster one SGD step
+    from ``base``, the regime the training loop actually produces.
+    Returns ``(w [K, D] f32, base [D] f32)``."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    base = 0.05 * jax.random.normal(jax.random.fold_in(key, 1), (D,))
+    w = base[None, :] + 1e-3 * jax.random.normal(
+        jax.random.fold_in(key, 2), (K, D)
+    )
+    return w.astype(jnp.float32), base.astype(jnp.float32)
+
+
+def _attacked(spec, w, base, key):
+    """The transmitted stack under ``spec``: the message attack where it
+    acts, else the gradient-scale emulation (a scaled deviation from the
+    global params is exactly what a scaled gradient sends)."""
+    w_att = spec.apply_message(w, B, key)
+    if spec.grad_scale != 1.0 and bool(jnp.all(w_att == w)):
+        dev = w[-B:] - base[None, :]
+        w_att = w.at[-B:].set(base[None, :] + spec.grad_scale * dev)
+    return w_att
+
+
+def simulate_cell(
+    attack_name: str,
+    mode: str,
+    *,
+    iters: int = 40,
+    onset: int = 10,
+    stop: Optional[int] = 30,
+    ladder: Tuple[str, ...] = ("mean", "trimmed_mean", "multi_krum"),
+    det: Optional[defense_lib.DetectorParams] = None,
+    pol: Optional[defense_lib.PolicyParams] = None,
+    seed: int = 0,
+) -> Dict[str, object]:
+    """One (attack, mode) cell: the defense loop run eagerly for ``iters``
+    iterations with the attack active on ``[onset, stop)``.
+
+    Reports detection latency relative to onset, the rung trajectory
+    (max/final/transitions), whether the policy de-escalated after the
+    attacker went quiet, and — under ``adaptive`` — the final aggregate's
+    distance from the honest centroid (the number a successful escalation
+    must keep small while the attack runs)."""
+    spec = attack_lib.resolve(attack_name)
+    det = det or defense_lib.DetectorParams()
+    pol = pol or defense_lib.PolicyParams(
+        up_n=3, down_m=8, n_rungs=len(ladder)
+    )
+    branches = defense_lib.make_branch_table(
+        ladder, honest_size=HONEST, impl="xla", maxiter=50, tol=1e-5,
+        clip_iters=3,
+    )
+    key0 = jax.random.PRNGKey(seed)
+    _, base = honest_stack(key0)
+    d_state = defense_lib.init_detector(K)
+    p_state = defense_lib.init_policy()
+    detect_iter = None
+    max_rung = 0
+    transitions = 0
+    prev_rung = 0
+    rung_at_stop = 0
+    agg_err = None
+    for t in range(iters):
+        kt = jax.random.fold_in(key0, 100 + t)
+        w = base[None, :] + 1e-3 * jax.random.normal(kt, (K, D))
+        w = w.astype(jnp.float32)
+        active = onset <= t and (stop is None or t < stop)
+        if active:
+            w = _attacked(spec, w, base, jax.random.fold_in(key0, 200 + t))
+        score, finite = defense_lib.client_scores(w, base)
+        d_state, flags = defense_lib.detector_update(d_state, score, finite, det)
+        p_state, _ = defense_lib.policy_update(p_state, jnp.sum(flags), pol)
+        rung = int(p_state[0])
+        if detect_iter is None and active and int(jnp.sum(flags)) > 0:
+            detect_iter = t - onset
+        max_rung = max(max_rung, rung)
+        transitions += int(rung != prev_rung)
+        prev_rung = rung
+        if stop is not None and t == stop - 1:
+            rung_at_stop = rung
+        if mode == "adaptive":
+            agg = branches[rung]((w, base, jax.random.fold_in(key0, 300 + t)))
+            if active:
+                agg_err = float(jnp.linalg.norm(agg - base))
+    final_rung = int(p_state[0])
+    cell: Dict[str, object] = {
+        "detect_iter": detect_iter,
+        "max_rung": max_rung,
+        "final_rung": final_rung,
+        "transitions": transitions,
+        "deescalated": stop is not None and final_rung < rung_at_stop,
+    }
+    if agg_err is not None:
+        cell["agg_err"] = round(agg_err, 5)
+    return cell
+
+
+def run_matrix(
+    attacks: List[str],
+    modes: List[str],
+    log=lambda s: print(s, file=sys.stderr, flush=True),
+    on_cell=None,
+    **sim_kw,
+) -> Dict[Cell, Dict[str, object]]:
+    for a in attacks:
+        attack_lib.resolve(a)  # fail fast on typos (onset syntax included)
+    grid: Dict[Cell, Dict[str, object]] = {}
+    for mode in modes:
+        for attack in attacks:
+            cell = simulate_cell(attack, mode, **sim_kw)
+            grid[(attack, mode)] = cell
+            log(f"[adaptive_matrix] attack={attack} mode={mode}: {cell}")
+            if on_cell is not None:
+                on_cell(attack, mode, cell)
+    return grid
+
+
+def markdown_table(grid: Dict[Cell, Dict[str, object]]) -> str:
+    """One ``attack x metric`` table per mode; undetected cells show ``-``
+    in the latency column so a silent attack can't read as instant."""
+    modes = sorted({m for _, m in grid})
+    attacks = sorted({a for a, _ in grid})
+    blocks = []
+    for m in modes:
+        head = (
+            f"**mode: {m}**\n\n| attack | detect_lat | max_rung | "
+            f"final_rung | deescalated |"
+        )
+        sep = "|---|---|---|---|---|"
+        rows = []
+        for a in attacks:
+            c = grid[(a, m)]
+            lat = "-" if c["detect_iter"] is None else str(c["detect_iter"])
+            rows.append(
+                f"| {a} | {lat} | {c['max_rung']} | {c['final_rung']} | "
+                f"{c['deescalated']} |"
+            )
+        blocks.append("\n".join([head, sep] + rows))
+    return "\n\n".join(blocks)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--attacks", default=None,
+                    help="comma list; default: every registered attack")
+    ap.add_argument("--modes", default="monitor,adaptive")
+    ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--onset", type=int, default=10,
+                    help="iteration the attack switches ON")
+    ap.add_argument("--stop", type=int, default=30,
+                    help="iteration the attack switches OFF (-1: never)")
+    ap.add_argument("--ladder", default="mean,trimmed_mean,multi_krum")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="pickle the grid here")
+    ap.add_argument("--obs-dir", default=None,
+                    help="also append adaptive_cell events (JSONL) here")
+    args = ap.parse_args(argv)
+
+    attacks = (
+        [a for a in args.attacks.split(",") if a]
+        if args.attacks
+        else sorted(ATTACKS.names())
+    )
+    modes = [m for m in args.modes.split(",") if m]
+    sinks = [obs_lib.StdoutSink()]
+    if args.obs_dir:
+        sinks.append(
+            obs_lib.JsonlSink(
+                obs_lib.events_path(args.obs_dir, "adaptive_matrix")
+            )
+        )
+    sink = obs_lib.MultiSink(sinks) if len(sinks) > 1 else sinks[0]
+    try:
+        grid = run_matrix(
+            attacks,
+            modes,
+            iters=args.iters,
+            onset=args.onset,
+            stop=None if args.stop < 0 else args.stop,
+            ladder=tuple(n for n in args.ladder.split(",") if n),
+            seed=args.seed,
+            on_cell=lambda attack, mode, cell: sink.emit(
+                obs_lib.make_event(
+                    "adaptive_cell", attack=attack, mode=mode, **cell
+                )
+            ),
+        )
+    finally:
+        sink.close()
+    print(markdown_table(grid), file=sys.stderr, flush=True)
+    if args.out:
+        io_lib.atomic_pickle(
+            args.out, {f"{a}|{m}": c for (a, m), c in grid.items()}
+        )
+        print(f"[adaptive_matrix] grid pickled to {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -209,6 +209,16 @@ def main(argv=None) -> None:
         corrupt_prob=args.corrupt_prob,
         corrupt_mode=args.corrupt_mode,
         corrupt_size=args.corrupt_size,
+        defense=args.defense,
+        defense_ladder=args.defense_ladder,
+        defense_warmup=args.defense_warmup,
+        defense_alpha=args.defense_alpha,
+        defense_drift=args.defense_drift,
+        defense_cusum=args.defense_cusum,
+        defense_z=args.defense_z,
+        defense_up=args.defense_up,
+        defense_down=args.defense_down,
+        defense_min_flagged=args.defense_min_flagged,
     )
     # stdout keeps one JSON object per completed cell (the shape scripts
     # already parse — schema stamps v/kind/ts are additive); --obs-dir tees

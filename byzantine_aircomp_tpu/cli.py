@@ -91,6 +91,33 @@ def add_knob_flags(p) -> None:
     p.add_argument("--corrupt-size", type=int, default=None,
                    help="number of corruption-eligible (honest) clients; "
                         "overrides the --fault scenario")
+    # online-defense surface (defense/); knob flags require --defense
+    p.add_argument("--defense", choices=["off", "monitor", "adaptive"],
+                   default="off",
+                   help="in-jit anomaly detection: monitor = score + report "
+                        "only, adaptive = escalate the aggregator through "
+                        "--defense-ladder (off is bit-identical to a run "
+                        "without the defense)")
+    p.add_argument("--defense-ladder", type=str,
+                   default="mean,trimmed_mean,multi_krum",
+                   help="comma-separated aggregator escalation ladder; "
+                        "under adaptive the first rung must equal --agg")
+    p.add_argument("--defense-warmup", type=int, default=5,
+                   help="iterations of baseline building before any flag")
+    p.add_argument("--defense-alpha", type=float, default=0.1,
+                   help="EMA rate of the per-client score baseline")
+    p.add_argument("--defense-drift", type=float, default=0.5,
+                   help="CUSUM drift allowance (in robust z-units)")
+    p.add_argument("--defense-cusum", type=float, default=8.0,
+                   help="CUSUM change-point alarm threshold")
+    p.add_argument("--defense-z", type=float, default=4.0,
+                   help="instantaneous robust z-score alarm threshold")
+    p.add_argument("--defense-up", type=int, default=3,
+                   help="consecutive suspicious iterations per escalation")
+    p.add_argument("--defense-down", type=int, default=20,
+                   help="consecutive clean iterations per de-escalation")
+    p.add_argument("--defense-min-flagged", type=int, default=1,
+                   help="flagged clients that make an iteration suspicious")
 
 
 ARG_TO_FIELD = {
@@ -125,6 +152,16 @@ ARG_TO_FIELD = {
     "corrupt_prob": ("corrupt_prob", None),
     "corrupt_mode": ("corrupt_mode", None),
     "corrupt_size": ("corrupt_size", None),
+    "defense": ("defense", None),
+    "defense_ladder": ("defense_ladder", None),
+    "defense_warmup": ("defense_warmup", None),
+    "defense_alpha": ("defense_alpha", None),
+    "defense_drift": ("defense_drift", None),
+    "defense_cusum": ("defense_cusum", None),
+    "defense_z": ("defense_z", None),
+    "defense_up": ("defense_up", None),
+    "defense_down": ("defense_down", None),
+    "defense_min_flagged": ("defense_min_flagged", None),
     "profile_dir": ("profile_dir", None),
     "obs_dir": ("obs_dir", None),
     "obs_stdout": ("obs_stdout", None),

@@ -1,0 +1,89 @@
+"""Online defense: in-jit anomaly scoring + adaptive aggregator escalation.
+
+The paper's receiver commits to one robust aggregator for the whole run,
+but the attack surface is dynamic — Byzantine clients can behave honestly
+for hundreds of rounds, then strike (``--attack signflip@100``).  This
+package is the runtime layer that watches the received stack and reacts:
+
+* :mod:`.scores`  — per-client anomaly statistics from the already-resident
+  [K, d] stack + robust EMA/CUSUM change-point detector (zero extra RNG,
+  state in the scan carry like ``ops/faults.py``);
+* :mod:`.policy`  — the escalation ladder (``mean -> trimmed_mean ->
+  multi_krum`` by default) as a branchless ``lax.switch`` with hysteresis;
+* :mod:`.events`  — per-round ``defense`` events through the existing obs
+  sinks + the round-metric packing shared with the harness record.
+
+Modes (``--defense``): ``off`` — no defense code is traced, the program /
+RNG stream / pickled record / config hash are bit-identical to a build
+without this package; ``monitor`` — detector + would-be rung tracked and
+reported, aggregation untouched (trajectory bit-identical to ``off``);
+``adaptive`` — the active rung picks the aggregator in-jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from . import events  # noqa: F401  (re-export for trainer/harness/analysis)
+from .policy import (  # noqa: F401
+    PolicyParams,
+    aggregate_switch,
+    init_policy,
+    make_branch_table,
+    policy_update,
+    validate_ladder,
+)
+from .scores import (  # noqa: F401
+    DetectorParams,
+    client_scores,
+    detector_update,
+    init_detector,
+)
+
+#: full defense carry: (detector_state, policy_state) — empty () when off
+DefenseState = tuple
+
+
+@dataclass(frozen=True)
+class DefenseSpec:
+    """Resolved static defense configuration for one run."""
+
+    mode: str                      # "monitor" | "adaptive"
+    ladder: Tuple[str, ...]
+    detector: DetectorParams
+    policy: PolicyParams
+
+
+def from_config(cfg) -> "DefenseSpec | None":
+    """Build the spec from FedConfig (None when ``defense == 'off'``).
+    Ladder validation already ran in ``cfg.validate()``."""
+    if cfg.defense == "off":
+        return None
+    ladder = cfg.defense_ladder_names()
+    return DefenseSpec(
+        mode=cfg.defense,
+        ladder=ladder,
+        detector=DetectorParams(
+            alpha=cfg.defense_alpha,
+            drift=cfg.defense_drift,
+            z_thresh=cfg.defense_z,
+            cusum_thresh=cfg.defense_cusum,
+            warmup=cfg.defense_warmup,
+        ),
+        policy=PolicyParams(
+            up_n=cfg.defense_up,
+            down_m=cfg.defense_down,
+            min_flagged=cfg.defense_min_flagged,
+            n_rungs=len(ladder),
+        ),
+    )
+
+
+def init_state(spec: "DefenseSpec | None", k: int) -> DefenseState:
+    """Initial scan-carried defense state for K clients (``()`` when off,
+    so the default program's carry and donation slots stay cost-free —
+    the fault-state idiom)."""
+    if spec is None:
+        return ()
+    return (init_detector(k), init_policy())

@@ -1,0 +1,88 @@
+"""Defense observability glue: round-metric unpacking + ``defense`` events.
+
+The jitted round step reduces its per-iteration defense observations to
+ONE [6] device vector (fed/train.py ``_round_core``) — rung at round end,
+max flagged clients, suspicious-iteration count, max composite score, max
+CUSUM, and intra-round rung transitions.  This module is the single place
+that knows that packing: the trainer, the harness record keys, and the
+``defense`` event emitted through the existing obs sinks all read it via
+:func:`round_metrics`, so the wire format cannot drift between consumers.
+
+Event schema (``obs/events.py`` registers the required trio): kind
+``defense`` with ``round`` / ``rung`` / ``flagged`` required, plus mode,
+the active rung's aggregator name, the previous round's rung and the
+derived transition direction — enough for ``analysis/defense_trace.py``
+to reconstruct the full escalation history from the stream alone.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+# order of the [6] per-round defense-metrics vector the jitted round emits
+METRIC_KEYS = (
+    "rung", "flagged", "suspicious_iters", "score_max", "cusum_max",
+    "transitions",
+)
+
+# defense-event field -> harness record path key (mirrors the fault-path
+# naming; obs/events.REFERENCE_KEY_MAP carries the same mapping)
+PATH_KEYS = {
+    "rung": "defenseRungPath",
+    "flagged": "defenseFlaggedPath",
+    "suspicious_iters": "defenseSuspiciousPath",
+    "score_max": "defenseScorePath",
+    "cusum_max": "defenseCusumPath",
+    "transitions": "defenseTransitionsPath",
+}
+
+
+def round_metrics(device_vec) -> Dict[str, float]:
+    """Unpack the round's [6] defense-metrics vector to named floats
+    (counts arrive as exact float integers; rung as a float index)."""
+    vals = [float(v) for v in np.asarray(device_vec)]
+    return dict(zip(METRIC_KEYS, vals))
+
+
+def active_agg(mode: str, ladder, rung: int, base_agg: str) -> str:
+    """The aggregator actually applied this round: the rung's ladder entry
+    under ``adaptive``, always the configured one under ``monitor`` (the
+    rung is tracked as what WOULD run, but never switches)."""
+    return ladder[rung] if mode == "adaptive" else base_agg
+
+
+def emit_round(
+    obs,
+    round_idx: int,
+    *,
+    mode: str,
+    agg: str,
+    metrics: Dict[str, float],
+    prev_rung: Optional[int] = None,
+) -> None:
+    """One ``defense`` event per round on the configured sinks.
+
+    ``prev_rung`` (the previous round's end rung, host-tracked) turns the
+    carried rung into an explicit transition field: "escalate" /
+    "deescalate" / None for steady state.
+    """
+    rung = int(metrics["rung"])
+    transition = None
+    if prev_rung is not None and rung != prev_rung:
+        transition = "escalate" if rung > prev_rung else "deescalate"
+    obs.emit(
+        "defense",
+        round=round_idx,
+        mode=mode,
+        rung=rung,
+        agg=agg,
+        prev_rung=prev_rung,
+        transition=transition,
+        flagged=metrics["flagged"],
+        suspicious_iters=metrics["suspicious_iters"],
+        score_max=metrics["score_max"],
+        cusum_max=metrics["cusum_max"],
+        transitions=metrics["transitions"],
+    )

@@ -1,0 +1,137 @@
+"""Escalation-ladder policy: hysteresis state machine + branchless switch.
+
+The policy is a pure function of carried state — (rung, up_streak,
+down_streak) ride the scan carry next to the detector state — so the whole
+defense, scoring through aggregator selection, stays inside the ONE jitted
+round program (retrace audit unchanged at a single lowering).
+
+Hysteresis: a round-iteration is *suspicious* when at least
+``min_flagged`` clients flag.  ``up_n`` consecutive suspicious iterations
+escalate one rung (streak resets, so climbing the whole ladder takes
+``up_n`` per rung — a transient cannot jump straight to the most
+expensive defense); ``down_m`` consecutive clean iterations de-escalate
+one rung.  Either counter resets on the opposite observation.
+
+In ``adaptive`` mode the active rung picks the aggregator through
+``lax.switch`` over a static table of closures built from the registry —
+branchless on-device dispatch, no host involvement, no retrace when the
+rung moves.  Every ladder entry is called with the trainer's full keyword
+surface (aggregators swallow unknown kwargs via ``**_``), with the fused
+epilogue and channel deferral disabled: the deferred-OMA read belongs to
+exactly one statically-known aggregator, which an adaptive rung is not
+(fed/train.py applies the standalone prepass instead — bit-identical
+channel statistics, one extra stack pass only in adaptive mode).
+
+Degraded/fault interplay: the branch closures inherit the trainer's
+``degraded`` flag, and the detector upstream freezes state on non-finite
+rows — so deep-fade erasures neither masquerade as attacks nor strip the
+fault hardening from whichever rung is active.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import AGGREGATORS
+
+#: policy carry: (rung i32, up_streak i32, down_streak i32)
+PolicyState = tuple
+
+
+@dataclass(frozen=True)
+class PolicyParams:
+    """Static hysteresis knobs (FedConfig defense_* fields)."""
+
+    up_n: int = 3          # consecutive suspicious iterations per escalation
+    down_m: int = 20       # consecutive clean iterations per de-escalation
+    min_flagged: int = 1   # flagged clients that make an iteration suspicious
+    n_rungs: int = 3       # ladder length (clamps the rung)
+
+
+def init_policy() -> PolicyState:
+    return (jnp.int32(0), jnp.int32(0), jnp.int32(0))
+
+
+def policy_update(pol: PolicyState, n_flagged, p: PolicyParams):
+    """One hysteresis step; returns ``(new_state, suspicious bool)``."""
+    rung, up, down = pol
+    suspicious = n_flagged >= p.min_flagged
+    up = jnp.where(suspicious, up + 1, 0)
+    down = jnp.where(suspicious, 0, down + 1)
+    escalate = up >= p.up_n
+    deescalate = (down >= p.down_m) & (rung > 0)
+    rung = jnp.clip(
+        rung + escalate.astype(jnp.int32) - deescalate.astype(jnp.int32),
+        0,
+        p.n_rungs - 1,
+    )
+    # a consumed streak restarts: each further rung needs fresh evidence
+    up = jnp.where(escalate, 0, up)
+    down = jnp.where(deescalate, 0, down)
+    return (rung, up, down), suspicious
+
+
+def validate_ladder(names: Sequence[str], base_agg: "str | None") -> None:
+    """Fail fast (config-validation time) on a ladder the switch cannot
+    realize: unknown names, channel-owning aggregators (gm/signmv transmit
+    INSIDE aggregation — there is no received stack for the other rungs to
+    share), or — in adaptive mode (``base_agg`` given) — a base rung that
+    disagrees with ``cfg.agg`` (the channel dispatch and run title key off
+    cfg.agg; the ladder must start there).  Monitor mode passes
+    ``base_agg=None``: the rung is only reported, never applied, so any
+    configured aggregator may be watched."""
+    if len(names) < 2:
+        raise ValueError(
+            f"defense ladder needs >= 2 rungs to escalate, got {list(names)}"
+        )
+    for n in names:
+        meta = AGGREGATORS.meta(n)  # raises on unknown names
+        if meta.get("owns_channel", False):
+            raise ValueError(
+                f"defense ladder rung {n!r} owns its channel (the AirComp "
+                f"transmission happens inside aggregation) — all rungs must "
+                f"aggregate the same received stack; use gm2 instead of gm"
+            )
+    if base_agg is not None and names[0] != base_agg:
+        raise ValueError(
+            f"defense ladder base rung {names[0]!r} must equal --agg "
+            f"{base_agg!r}: rung 0 IS the configured aggregator (set "
+            f"--agg {names[0]} or reorder --defense-ladder)"
+        )
+
+
+def make_branch_table(
+    names: Sequence[str], *, honest_size: int, **static_kw
+) -> List[Callable]:
+    """Static table of aggregator closures for ``lax.switch``.
+
+    Each branch takes one operand tuple ``(w_agg, guess, key)`` (the only
+    traced per-iteration inputs) and closes over the static keyword
+    surface.  All branches return f32 [d] so the switch has one output
+    type whatever rung runs.
+    """
+    branches = []
+    for n in names:
+        fn = AGGREGATORS.get(n)
+
+        def branch(operand, fn=fn):
+            w_agg, guess, key = operand
+            return fn(
+                w_agg,
+                honest_size=honest_size,
+                guess=guess,
+                key=key,
+                **static_kw,
+            ).astype(jnp.float32)
+
+        branches.append(branch)
+    return branches
+
+
+def aggregate_switch(rung, branches: List[Callable], w_agg, guess, key):
+    """Branchless rung dispatch: one ``lax.switch`` in the traced program."""
+    return jax.lax.switch(rung, branches, (w_agg, guess, key))

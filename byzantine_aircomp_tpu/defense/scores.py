@@ -1,0 +1,175 @@
+"""In-jit per-client anomaly scores + robust change-point detector.
+
+Everything here runs INSIDE the jitted round step on the already-resident
+[K, d] client stack — no extra HBM pass beyond what aggregation reads
+anyway, no host round-trips, no RNG consumption (the detector is a pure
+function of the stack, so the round's key stream is untouched whatever the
+defense mode).  The detector state rides the scan carry exactly like the
+fault state (``ops/faults.py``), keeping the retrace audit at one lowering.
+
+Three cheap statistics per client (:func:`client_scores`):
+
+* **update norm** ``||w_i - g||`` relative to the finite-median norm — a
+  sign-flipped or scaled row moves ~2||g|| while honest rows move ~gamma;
+* **cosine to the finite centroid** of the updates — honest gradients
+  roughly agree in direction, an inverted row anti-correlates;
+* **pairwise-distance summary** reusing :func:`ops.aggregators
+  .pairwise_sq_dists` — the mean squared distance to the finite rows,
+  relative to its finite median (the Krum intuition as a score, not a
+  selection).
+
+The composite score is scale-free (each term is a relative excess over the
+honest median), so one threshold works across models/learning rates.
+
+Per-client baselines (:func:`detector_update`) are robust EMAs with a
+huberized innovation — a striking attacker cannot drag its own baseline up
+fast enough to hide — plus a one-sided CUSUM change-point statistic, the
+classic detector for "small persistent shift" onsets that a pure z-test
+misses.  Non-finite rows (deep-fade erasures, NaN corruption from
+``ops/faults.py``) are EXCLUDED from every median and their detector state
+is held frozen, so a fault burst neither flags as an attack nor poisons
+the baselines it will be compared against when it recovers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..ops import aggregators as agg_lib
+
+#: detector carry: (step i32 scalar, ema [K] f32, dev [K] f32, cusum [K] f32)
+DetectorState = tuple
+
+
+@dataclass(frozen=True)
+class DetectorParams:
+    """Static detector knobs (FedConfig defense_* fields; see fed/config.py
+    for semantics and defaults)."""
+
+    alpha: float = 0.1       # EMA smoothing for baseline mean / deviation
+    drift: float = 0.5       # CUSUM allowance k (in robust sigmas)
+    z_thresh: float = 4.0    # instantaneous flag at z > z_thresh sigmas
+    cusum_thresh: float = 8.0  # change-point flag at cusum > this
+    warmup: int = 5          # iterations before flags/CUSUM arm
+    clip: float = 3.0        # huber clip on the baseline innovation (sigmas)
+    eps: float = 1e-6        # deviation floor
+
+
+def init_detector(k: int) -> DetectorState:
+    return (
+        jnp.int32(0),
+        jnp.zeros(k, jnp.float32),
+        jnp.zeros(k, jnp.float32),
+        jnp.zeros(k, jnp.float32),
+    )
+
+
+def masked_median(x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Median of ``x[mask]`` with static shapes: masked-out entries sort to
+    +inf and the order-statistic index becomes the dynamic ``(n-1)//2``
+    (the same idiom as the degraded coordinatewise median).  n = 0 returns
+    +inf — callers guard (an all-masked stack is finite-guard territory)."""
+    n = jnp.sum(mask)
+    srt = jnp.sort(jnp.where(mask, x, jnp.inf))
+    idx = jnp.maximum(n - 1, 0) // 2
+    return jnp.take(srt, idx)
+
+
+def client_scores(w_stack: jnp.ndarray, guess: jnp.ndarray):
+    """Composite per-client anomaly score [K] plus the finite-row mask [K].
+
+    Each term is a nonnegative RELATIVE excess (honest rows score ~0):
+
+        relu(norm_i / med(norm) - 1)        magnitude blow-up
+      + relu(1 - cos(delta_i, centroid))    direction disagreement
+      + relu(dist_i / med(dist) - 1)        pairwise-distance outlier
+
+    Medians and the centroid run over FINITE rows only; non-finite rows
+    score exactly 0 (they carry no evidence of Byzantine intent — the
+    fault subsystem already accounts for them via effective-K).
+    """
+    finite = agg_lib._finite_rows(w_stack)
+    delta = (w_stack - guess[None, :]).astype(jnp.float32)
+    safe_delta = jnp.where(finite[:, None], delta, 0.0)
+
+    norms = jnp.sqrt(jnp.sum(safe_delta * safe_delta, axis=1))
+    med_norm = masked_median(norms, finite)
+    # med_norm can be +inf only when zero rows are finite; the jnp.where
+    # on `finite` below zeroes every score in that degenerate round
+    norm_term = jnp.maximum(norms / jnp.maximum(med_norm, 1e-12) - 1.0, 0.0)
+
+    cent = agg_lib._finite_centroid(delta, finite)
+    cent_norm = jnp.sqrt(jnp.sum(cent * cent))
+    cos = jnp.sum(safe_delta * cent[None, :], axis=1) / (
+        jnp.maximum(norms, 1e-12) * jnp.maximum(cent_norm, 1e-12)
+    )
+    cos_term = jnp.maximum(1.0 - cos, 0.0)
+
+    # mean squared distance to the OTHER finite rows; poisoned rows hold
+    # inf distances, masked out of every honest row's mean
+    dists = agg_lib.pairwise_sq_dists(w_stack)
+    pair_mask = finite[None, :] & ~jnp.eye(w_stack.shape[0], dtype=bool)
+    n_others = jnp.maximum(jnp.sum(pair_mask, axis=1), 1)
+    dist_mean = (
+        jnp.sum(jnp.where(pair_mask, dists, 0.0), axis=1) / n_others
+    )
+    med_dist = masked_median(dist_mean, finite)
+    dist_term = jnp.maximum(
+        dist_mean / jnp.maximum(med_dist, 1e-12) - 1.0, 0.0
+    )
+
+    score = jnp.where(finite, norm_term + cos_term + dist_term, 0.0)
+    return score, finite
+
+
+def detector_update(
+    det: DetectorState,
+    score: jnp.ndarray,
+    finite: jnp.ndarray,
+    p: DetectorParams,
+):
+    """One detector step: robust EMA baselines + one-sided CUSUM.
+
+    Returns ``(new_state, flags [K] bool)``.  The baseline innovation is
+    huberized (clipped at ``p.clip`` robust sigmas) so an attacking client
+    barely moves its own baseline; the deviation is an EMA of |clipped
+    residual| (a robust scale proxy).  Step 0 seeds ema/dev directly from
+    the first observation.  CUSUM accumulates only after warmup — with a
+    near-zero seeded deviation the first z-scores are noise, not evidence.
+    The CUSUM increment uses the CLIPPED z and the statistic saturates at
+    2x its alarm threshold: detection only needs the threshold crossing,
+    and an unbounded accumulation would otherwise take arbitrarily long to
+    decay after the attacker goes quiet — starving the policy's clean-run
+    counter and making de-escalation unreachable.  Non-finite rows hold
+    their state and never flag (mirrors the NumPy oracle in
+    tests/test_defense.py line for line).
+    """
+    step, ema, dev, cusum = det
+    warm = step >= p.warmup
+
+    sigma = dev + p.eps
+    resid = score - ema
+    z = resid / sigma
+    clipped = jnp.clip(resid, -p.clip * sigma, p.clip * sigma)
+    ema_new = jnp.where(step == 0, score, ema + p.alpha * clipped)
+    dev_new = jnp.where(
+        step == 0,
+        jnp.abs(score) + p.eps,
+        (1.0 - p.alpha) * dev + p.alpha * jnp.abs(clipped),
+    )
+    z_c = jnp.clip(z, -p.clip, p.clip)
+    cusum_new = jnp.where(
+        warm,
+        jnp.minimum(
+            jnp.maximum(cusum + z_c - p.drift, 0.0), 2.0 * p.cusum_thresh
+        ),
+        jnp.zeros_like(cusum),
+    )
+    flags = warm & ((z > p.z_thresh) | (cusum_new > p.cusum_thresh)) & finite
+
+    ema = jnp.where(finite, ema_new, ema)
+    dev = jnp.where(finite, dev_new, dev)
+    cusum = jnp.where(finite, cusum_new, cusum)
+    return (step + 1, ema, dev, cusum), flags

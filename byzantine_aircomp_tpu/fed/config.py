@@ -8,6 +8,7 @@ seed 2021, gm/gm2 maxiter 1000 tol 1e-5 (``:350``).
 
 from __future__ import annotations
 
+import dataclasses
 import typing
 from dataclasses import dataclass, field
 from typing import Optional
@@ -74,6 +75,35 @@ class FedConfig:
     ge_p_gb: Optional[float] = None
     ge_p_bg: Optional[float] = None
     ge_bad_mult: Optional[float] = None
+
+    # online defense (defense/): "off" = no defense code is traced (the
+    # program, RNG stream, pickled record and config_hash are bit-identical
+    # to a build without the subsystem); "monitor" = in-jit anomaly
+    # detector + would-be escalation rung tracked and reported, aggregation
+    # untouched (trajectory identical to off); "adaptive" = the rung picks
+    # the aggregator from the escalation ladder via an in-jit lax.switch.
+    # The knobs below follow the fault-knob contract: any non-default value
+    # with defense="off" is an error (it would silently do nothing)
+    defense: str = "off"
+    # comma-separated escalation ladder, cheapest rung first; adaptive mode
+    # requires ladder[0] == agg (rung 0 IS the configured aggregator) and
+    # rejects channel-owning rungs (gm/signmv — their AirComp transmission
+    # happens inside aggregation, so the rungs can't share one received
+    # stack)
+    defense_ladder: str = "mean,trimmed_mean,multi_krum"
+    # detector: iterations before flags arm, EMA smoothing, CUSUM
+    # allowance/threshold (robust sigmas), instantaneous z threshold
+    defense_warmup: int = 5
+    defense_alpha: float = 0.1
+    defense_drift: float = 0.5
+    defense_cusum: float = 8.0
+    defense_z: float = 4.0
+    # hysteresis: escalate after N consecutive suspicious iterations,
+    # de-escalate after M consecutive clean ones; an iteration is
+    # suspicious when >= min_flagged clients flag
+    defense_up: int = 3
+    defense_down: int = 20
+    defense_min_flagged: int = 1
 
     # aggregator options (reference options dict, :350)
     agg_maxiter: int = 1000
@@ -250,6 +280,19 @@ class FedConfig:
         "corrupt_mode", "corrupt_size", "ge_p_gb", "ge_p_bg", "ge_bad_mult",
     )
 
+    # defense knobs that require --defense != off (fault-knob contract);
+    # harness.config_hash also reads this tuple to keep the hash of every
+    # defense-off config identical to pre-defense builds
+    _DEFENSE_KNOBS = (
+        "defense_ladder", "defense_warmup", "defense_alpha", "defense_drift",
+        "defense_cusum", "defense_z", "defense_up", "defense_down",
+        "defense_min_flagged",
+    )
+
+    def defense_ladder_names(self) -> tuple:
+        """The escalation ladder as a tuple of aggregator names."""
+        return tuple(n for n in self.defense_ladder.split(",") if n)
+
     def fault_overrides(self) -> dict:
         """The non-None fault knobs, as ``dataclasses.replace`` overrides
         for the named FaultSpec (ops/faults.resolve)."""
@@ -387,6 +430,60 @@ class FedConfig:
                 f"corrupt_size {spec.corrupt_size} exceeds the "
                 f"{self.honest_size} honest clients (corruption models "
                 f"crashed honest senders; Byzantine rows are the attack's)"
+            )
+        assert self.defense in ("off", "monitor", "adaptive"), (
+            f"defense must be off|monitor|adaptive, got {self.defense!r}"
+        )
+        if self.defense == "off":
+            # fault-knob contract: tuning a defense knob without enabling
+            # the defense would silently do nothing
+            defaults = {
+                f.name: f.default for f in dataclasses.fields(self)
+            }
+            touched = sorted(
+                k for k in self._DEFENSE_KNOBS
+                if getattr(self, k) != defaults[k]
+            )
+            assert not touched, (
+                f"defense knobs {touched} require --defense monitor|adaptive "
+                f"(they configure the detector/ladder and would otherwise "
+                f"silently do nothing)"
+            )
+        else:
+            assert self.participation == 1.0, (
+                "defense requires full participation: the detector EMA/"
+                "CUSUM state is [K]-indexed by the full client stack"
+            )
+            assert self.defense_warmup >= 1, (
+                f"defense_warmup must be >= 1, got {self.defense_warmup}"
+            )
+            assert 0.0 < self.defense_alpha <= 1.0, (
+                f"defense_alpha must be in (0, 1], got {self.defense_alpha}"
+            )
+            assert (
+                self.defense_drift > 0
+                and self.defense_z > 0
+                and self.defense_cusum > 0
+            ), (
+                f"defense drift/z/cusum thresholds must be positive, got "
+                f"{self.defense_drift}, {self.defense_z}, {self.defense_cusum}"
+            )
+            assert (
+                self.defense_up >= 1
+                and self.defense_down >= 1
+                and self.defense_min_flagged >= 1
+            ), (
+                f"defense hysteresis knobs must be >= 1, got "
+                f"up={self.defense_up}, down={self.defense_down}, "
+                f"min_flagged={self.defense_min_flagged}"
+            )
+            # ladder resolution fails here, not at trace time; in adaptive
+            # mode rung 0 must be the configured aggregator
+            from ..defense.policy import validate_ladder
+
+            validate_ladder(
+                self.defense_ladder_names(),
+                self.agg if self.defense == "adaptive" else None,
             )
         return self
 

@@ -148,6 +148,17 @@ def run_title(cfg: FedConfig) -> str:
         title += f"_fault{cfg.fault}"
         for knob, val in sorted(cfg.fault_overrides().items()):
             title += f"_{knob.replace('_', '')}{val}"
+    if cfg.defense != "off":
+        # defense mode + any non-default knobs (fault idiom): an adaptive
+        # run rewrites the aggregation trajectory, and even monitor runs
+        # must not alias defended checkpoints with undefended ones —
+        # validate() keeps every knob at its default when the defense is
+        # off, so off-runs keep the exact pre-defense title
+        title += f"_def{cfg.defense}"
+        for knob in FedConfig._DEFENSE_KNOBS:
+            if _non_default(cfg, knob):
+                val = str(getattr(cfg, knob)).replace(",", "-")
+                title += f"_{knob.replace('_', '')}{val}"
     if cfg.mark:
         title += f"_{cfg.mark}"
     return title
@@ -178,6 +189,12 @@ def config_hash(cfg: FedConfig) -> str:
         # between an observed and an unobserved run of the same config
         "obs_dir", "obs_stdout", "log_file", "quiet",
     )
+    if cfg.defense == "off":
+        # a defense-off config must hash identically to builds that
+        # predate the defense fields (checkpoint/pickle continuity);
+        # validate() pins every defense knob to its default when the
+        # defense is off, so skipping them drops no information
+        skip = skip + ("defense",) + FedConfig._DEFENSE_KNOBS
     items = sorted(
         (f.name, repr(getattr(cfg, f.name)))
         for f in dataclasses.fields(cfg)
@@ -308,14 +325,18 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
         import jax
 
         # everything beyond flat params that must survive a resume:
-        # server-optimizer state, the client-momentum buffer, and the
+        # server-optimizer state, the client-momentum buffer, the
         # fault-injection carry (stale-update buffer + Gilbert-Elliott
-        # channel state), as one pytree so the leaf-count match covers all
+        # channel state), the defense carry (detector baselines + policy
+        # rung/streaks) and the attack-onset iteration counter, as one
+        # pytree so the leaf-count match covers all
         def _extra_state(t):
             return (
                 getattr(t, "server_opt_state", ()),
                 getattr(t, "client_m", ()),
                 getattr(t, "fault_state", ()),
+                getattr(t, "defense_state", ()),
+                getattr(t, "attack_iter", ()),
             )
 
         checkpoint_fn = lambda r, t: checkpoint.save(
@@ -337,7 +358,10 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
                 own_state = _extra_state(trainer)
                 own_leaves = jax.tree.leaves(own_state)
                 if len(extra_leaves) == len(own_leaves) and extra_leaves:
-                    server_state, client_m, fault_state = jax.tree.unflatten(
+                    (
+                        server_state, client_m, fault_state, defense_state,
+                        attack_iter,
+                    ) = jax.tree.unflatten(
                         jax.tree.structure(own_state),
                         [
                             jax.device_put(l, own.sharding)
@@ -349,6 +373,10 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
                         trainer.client_m = client_m
                     if jax.tree.leaves(fault_state):  # ()-only when disabled
                         trainer.fault_state = fault_state
+                    if jax.tree.leaves(defense_state):
+                        trainer.defense_state = defense_state
+                    if not isinstance(attack_iter, tuple):  # scalar when on
+                        trainer.attack_iter = attack_iter
                 elif len(extra_leaves) != len(own_leaves):
                     log(
                         "WARNING: checkpoint extra state "
@@ -373,6 +401,7 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
         agg=cfg.agg,
         attack=cfg.attack,
         fault=cfg.fault,
+        defense=cfg.defense,
         seed=cfg.seed,
         # the same static accounting benchmarks/agg_kernels.py reports, so
         # the trainer and the microbench can never disagree on HBM math
@@ -463,6 +492,13 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
         record["faultErasedPath"] = paths["faultErasedPath"]
         record["faultCorruptPath"] = paths["faultCorruptPath"]
         record["effectiveKPath"] = paths["effectiveKPath"]
+    if cfg.defense != "off":
+        from ..defense import events as defense_events
+
+        record["defense"] = cfg.defense
+        record["defenseLadder"] = list(cfg.defense_ladder_names())
+        for path_key in defense_events.PATH_KEYS.values():
+            record[path_key] = paths[path_key]
     if record_in_file:
         io_lib.atomic_pickle(path, record)
     return record

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from .. import defense as defense_lib
 from .. import obs as obs_lib
 from ..data import datasets as data_lib
 from ..ops import aggregators as agg_lib
@@ -90,6 +91,18 @@ class FedTrainer:
         self.attack = attack_lib.resolve(cfg.attack)
         self.fault = fault_lib.resolve(cfg.fault, cfg.fault_overrides())
         self.agg_fn = agg_lib.resolve(cfg.agg)
+        # online defense (defense/): None when --defense off, so the default
+        # configuration traces no scoring code and carries no detector state
+        self.defense = defense_lib.from_config(cfg)
+        # delayed attack ("name@R", AttackSpec.onset_round): Byzantine rows
+        # behave honestly until the carried iteration counter reaches the
+        # onset.  The threshold is in GLOBAL ITERATIONS (rounds *
+        # display_interval) compared against a carried i32, so multi-round
+        # scans and checkpoint-resumed runs agree with the per-round path
+        if self.attack is not None and self.attack.onset_round is not None:
+            self._attack_onset = self.attack.onset_round * cfg.display_interval
+        else:
+            self._attack_onset = None
         self.num_classes = self.dataset.num_classes
 
         model_kw = dict(num_classes=self.num_classes)
@@ -206,6 +219,11 @@ class FedTrainer:
             and self._agg_impl == "pallas"
             and self.fault is None
         )
+        if self.defense is not None and self.defense.mode == "adaptive":
+            # the deferred-OMA read belongs to exactly ONE statically-known
+            # aggregator; an adaptive rung is not static, so every rung
+            # consumes the same standalone channel prepass instead
+            self._fused_epilogue = False
 
         # server optimizer over the pseudo-gradient (FedAvgM / FedAdam);
         # "none" = take the aggregate directly (reference :354-358)
@@ -243,6 +261,20 @@ class FedTrainer:
         # executed round ((), i.e. absent, when faults are off)
         self.last_fault_metrics = ()
 
+        # defense carry (defense/__init__.init_state): detector EMA/CUSUM
+        # baselines + policy rung/streaks, [K]-indexed like the fault state
+        # and carried the same way; () when the defense is off.  The sharded
+        # trainer re-lays the [K] leaves out (replicated) afterwards.
+        self.defense_state = defense_lib.init_state(self.defense, cfg.node_size)
+        # per-round [rung, flagged, suspicious, score, cusum, transitions]
+        # from the last executed round (() when the defense is off)
+        self.last_defense_metrics = ()
+        # attack-onset iteration counter: i32 in the carry with "@R" syntax,
+        # () otherwise so the default program's carry stays cost-free
+        self.attack_iter = (
+            jnp.int32(0) if self._attack_onset is not None else ()
+        )
+
         # per-round key stream; model init above stays threefry so initial
         # params are identical whatever impl drives the round RNG.  Typed
         # keys (jax.random.key) carry their impl — a raw PRNGKey array of a
@@ -259,16 +291,17 @@ class FedTrainer:
         # bookkeeping — the traced program, RNG stream and outputs are
         # bit-identical; steady-state enforcement is the harness's/CI's
         self.retrace = obs_lib.RetraceDetector()
-        # arg 3 is the fault state — an empty pytree when faults are off,
-        # so its donation slot contributes no buffers to the default program
+        # args 3-5 are the fault / defense / attack-onset states — empty
+        # pytrees when the corresponding feature is off, so their donation
+        # slots contribute no buffers to the default program
         self._round_fn = jax.jit(
             self.retrace.wrap("round_fn", self._build_round_fn()),
-            donate_argnums=(0, 1, 2, 3),
+            donate_argnums=(0, 1, 2, 3, 4, 5),
             compiler_options=copts,
         )
         self._multi_round_fn = jax.jit(
             self.retrace.wrap("multi_round_fn", self._build_multi_round_fn()),
-            donate_argnums=(0, 1, 2, 3),
+            donate_argnums=(0, 1, 2, 3, 4, 5),
             compiler_options=copts,
         )
         self._eval_fn = jax.jit(
@@ -359,6 +392,35 @@ class FedTrainer:
             self._per_client_weights, in_axes=(None, 0, 0, 0)
         )(flat_params, x, y, part_mask)
 
+    def _defense_branches(self, agg_honest: int):
+        """Static ``lax.switch`` branch table for the adaptive ladder.
+
+        Built at TRACE time (not in ``__init__``) so the sharded trainer's
+        post-constructor ``_agg_impl`` override reaches the closures.  Every
+        rung gets the trainer's full keyword surface (aggregators swallow
+        unknown kwargs) with the fused epilogue off — see the mode gate in
+        ``__init__``."""
+        cfg = self.cfg
+        return defense_lib.make_branch_table(
+            self.defense.ladder,
+            honest_size=agg_honest,
+            noise_var=cfg.noise_var,
+            maxiter=cfg.agg_maxiter,
+            tol=cfg.agg_tol,
+            p_max=cfg.gm_p_max,
+            impl=self._agg_impl,
+            fused_epilogue=False,
+            oma_key=None,
+            m=cfg.krum_m,
+            clip_tau=cfg.clip_tau,
+            clip_iters=cfg.clip_iters,
+            sign_eta=cfg.sign_eta,
+            dnc_iters=cfg.dnc_iters,
+            dnc_sub_dim=cfg.dnc_sub_dim,
+            dnc_c=cfg.dnc_c,
+            degraded=self.fault is not None,
+        )
+
     def _client_stack_momentum(self, flat_params, x, y, part_mask, m_prev):
         """Momentum variant of ``_client_stack``: returns (stack, new [m, d]
         momentum rows)."""
@@ -386,8 +448,18 @@ class FedTrainer:
         ``self.fault``, so the fault-free program (structure, RNG stream,
         outputs) is bit-identical to the pre-fault one."""
         cfg = self.cfg
-        flat_params, opt_state, client_m, fault_state = carry
+        (
+            flat_params, opt_state, client_m, fault_state, defense_state,
+            attack_iter,
+        ) = carry
         m_h, m_b = self._part_h, self._part_b
+        # delayed attack: one traced bool gates EVERY Byzantine behavior
+        # (data, gradient and message level) until the onset iteration
+        part_mask = self._part_mask
+        attack_on = None
+        if self._attack_onset is not None:
+            attack_on = attack_iter >= self._attack_onset
+            part_mask = part_mask & attack_on
         # extra keys exist only on the programs that need them, so the
         # default configuration consumes the exact default RNG stream
         # (checkpoint/replay compatible)
@@ -448,7 +520,7 @@ class FedTrainer:
                     client_m[part] if cfg.participation < 1.0 else client_m
                 )
                 w_stack, m_rows = self._client_stack_momentum(
-                    flat_params, x, y, self._part_mask, m_prev
+                    flat_params, x, y, part_mask, m_prev
                 )
                 client_m = (
                     client_m.at[part].set(m_rows)
@@ -458,7 +530,7 @@ class FedTrainer:
                 client_m = self._constrain_stack(client_m)
             else:
                 w_stack = self._client_stack(
-                    flat_params, x, y, self._part_mask
+                    flat_params, x, y, part_mask
                 )
             w_stack = self._constrain_stack(w_stack)
 
@@ -481,8 +553,12 @@ class FedTrainer:
             # attack_param BEFORE its no-op early-out, so a bogus knob
             # fails loudly (ops/attacks.py) instead of being ignored
             if self.attack is not None:
-                w_stack = self.attack.apply_message(
+                w_att = self.attack.apply_message(
                     w_stack, m_b, k_msg, param=cfg.attack_param
+                )
+                w_stack = (
+                    w_att if attack_on is None
+                    else jnp.where(attack_on, w_att, w_stack)
                 )
 
         if self.fault is not None:
@@ -517,6 +593,40 @@ class FedTrainer:
                 else:
                     w_stack = channel_lib.oma(k_chan, w_stack, cfg.noise_var)
 
+        defense_metrics = ()
+        rung = None
+        if self.defense is not None:
+            with jax.named_scope("defense_score"):
+                # score the received [K, d] stack (post-attack, post-fault,
+                # post-standalone-channel; under monitor + fused deferral
+                # the OMA noise lands inside the aggregator's read, so the
+                # detector sees the noiseless received stack — monitor
+                # never acts on the rung, so that is purely observational).
+                # The detector freezes state on non-finite rows, so deep-
+                # fade erasures neither trip flags nor corrupt baselines.
+                det, pol = defense_state
+                score, finite = defense_lib.client_scores(
+                    w_stack, flat_params
+                )
+                det, flags = defense_lib.detector_update(
+                    det, score, finite, self.defense.detector
+                )
+                n_flagged = jnp.sum(flags)
+                pol, suspicious = defense_lib.policy_update(
+                    pol, n_flagged, self.defense.policy
+                )
+                rung = pol[0]
+                defense_state = (det, pol)
+                # per-iteration observations; _round_core reduces them to
+                # the [6] round vector (defense/events.METRIC_KEYS)
+                defense_metrics = jnp.stack([
+                    rung.astype(jnp.float32),
+                    n_flagged.astype(jnp.float32),
+                    suspicious.astype(jnp.float32),
+                    jnp.max(score),
+                    jnp.max(det[3]),
+                ])
+
         agg_honest = m_h
         w_for_agg = w_stack
         if cfg.bucket_size > 1:
@@ -550,32 +660,43 @@ class FedTrainer:
             # arithmetic stays f32 via promotion / in-kernel upcast, and
             # the aggregate is cast back so the params carry stays f32
             w_agg = w_for_agg.astype(self._stack_dtype)
-            aggregated = self.agg_fn(
-                w_agg,
-                honest_size=agg_honest,
-                key=k_agg,
-                noise_var=cfg.noise_var,
-                guess=flat_params,
-                maxiter=cfg.agg_maxiter,
-                tol=cfg.agg_tol,
-                p_max=cfg.gm_p_max,
-                impl=self._agg_impl,
-                # single-read selection epilogue + deferred channel
-                # (ops/aggregators.py dispatch; **_ on other aggregators)
-                fused_epilogue=self._fused_epilogue,
-                oma_key=oma_key,
-                m=cfg.krum_m,
-                clip_tau=cfg.clip_tau,
-                clip_iters=cfg.clip_iters,
-                sign_eta=cfg.sign_eta,
-                dnc_iters=cfg.dnc_iters,
-                dnc_sub_dim=cfg.dnc_sub_dim,
-                dnc_c=cfg.dnc_c,
-                # graceful degradation (ops/aggregators.py): under faults
-                # the static rules adapt to the per-round effective K;
-                # False traces the literal pre-fault aggregator code
-                degraded=self.fault is not None,
-            )
+            if self.defense is not None and self.defense.mode == "adaptive":
+                # branchless rung dispatch (defense/policy.py): ONE
+                # lax.switch over the static ladder table, every branch
+                # reading the same post-channel stack.  Rung 0 is the
+                # configured aggregator (cfg.validate enforces it), so an
+                # attack-free run aggregates exactly as --defense off does
+                aggregated = defense_lib.aggregate_switch(
+                    rung, self._defense_branches(agg_honest),
+                    w_agg, flat_params, k_agg,
+                )
+            else:
+                aggregated = self.agg_fn(
+                    w_agg,
+                    honest_size=agg_honest,
+                    key=k_agg,
+                    noise_var=cfg.noise_var,
+                    guess=flat_params,
+                    maxiter=cfg.agg_maxiter,
+                    tol=cfg.agg_tol,
+                    p_max=cfg.gm_p_max,
+                    impl=self._agg_impl,
+                    # single-read selection epilogue + deferred channel
+                    # (ops/aggregators.py dispatch; **_ on other aggregators)
+                    fused_epilogue=self._fused_epilogue,
+                    oma_key=oma_key,
+                    m=cfg.krum_m,
+                    clip_tau=cfg.clip_tau,
+                    clip_iters=cfg.clip_iters,
+                    sign_eta=cfg.sign_eta,
+                    dnc_iters=cfg.dnc_iters,
+                    dnc_sub_dim=cfg.dnc_sub_dim,
+                    dnc_c=cfg.dnc_c,
+                    # graceful degradation (ops/aggregators.py): under faults
+                    # the static rules adapt to the per-round effective K;
+                    # False traces the literal pre-fault aggregator code
+                    degraded=self.fault is not None,
+                )
             aggregated = aggregated.astype(jnp.float32)
             if self.fault is not None:
                 # receiver-side finite-guard — the last line of defense the
@@ -602,7 +723,12 @@ class FedTrainer:
             lambda w: jnp.float32(0.0),
             w_stack,
         )
-        carry_out = (new_flat, opt_state, client_m, fault_state)
+        if self._attack_onset is not None:
+            attack_iter = attack_iter + 1
+        carry_out = (
+            new_flat, opt_state, client_m, fault_state, defense_state,
+            attack_iter,
+        )
         if self.fault is not None:
             # effective K = finite rows the receiver actually aggregates
             # over (post-fault, pre-bucketing); the other three are this
@@ -611,21 +737,24 @@ class FedTrainer:
             fault_metrics = jnp.stack(
                 [n_dropped, n_erased, n_corrupt, eff_k]
             )
-            return carry_out, (variance, fault_metrics)
-        return carry_out, variance
+        else:
+            fault_metrics = ()
+        return carry_out, (variance, fault_metrics, defense_metrics)
 
     def _round_core(
-        self, flat_params, opt_state, client_m, fault_state, round_key,
-        x_train, y_train
+        self, flat_params, opt_state, client_m, fault_state, defense_state,
+        attack_iter, round_key, x_train, y_train
     ):
         """One round (display_interval scanned iterations) as a pure fn.
 
-        Returns ``(params, opt_state, client_m, fault_state, variance,
-        fault_metrics)`` where fault_metrics is the round's reduced
-        [dropped, erased, corrupt, effective_k] (event counts summed over
-        the interval, effective K at its per-iteration MINIMUM — the
-        worst moment is what resilience claims are about) — or ``()``
-        with faults off, keeping that program's output structure free."""
+        Returns ``(params, opt_state, client_m, fault_state, defense_state,
+        attack_iter, variance, fault_metrics, defense_metrics)`` where
+        fault_metrics is the round's reduced [dropped, erased, corrupt,
+        effective_k] (event counts summed over the interval, effective K at
+        its per-iteration MINIMUM — the worst moment is what resilience
+        claims are about) and defense_metrics is the [6] vector of
+        ``defense/events.METRIC_KEYS`` — either is ``()`` when its feature
+        is off, keeping that program's output structure free."""
         interval = self.cfg.display_interval
         keys = jax.random.split(round_key, interval)
         want = jnp.arange(interval) == interval - 1
@@ -634,18 +763,41 @@ class FedTrainer:
             key, want_var = kf
             return self._iteration(carry, key, x_train, y_train, want_var)
 
-        (final, opt_final, m_final, f_final), out = jax.lax.scan(
-            it, (flat_params, opt_state, client_m, fault_state), (keys, want)
+        (final, opt_final, m_final, f_final, d_final, a_final), (
+            variances, fms, dms
+        ) = jax.lax.scan(
+            it,
+            (flat_params, opt_state, client_m, fault_state, defense_state,
+             attack_iter),
+            (keys, want),
         )
         if self.fault is not None:
-            variances, fm = out  # fm: [interval, 4]
             fault_metrics = jnp.concatenate(
-                [jnp.sum(fm[:, :3], axis=0), jnp.min(fm[:, 3:], axis=0)]
+                [jnp.sum(fms[:, :3], axis=0), jnp.min(fms[:, 3:], axis=0)]
             )
         else:
-            variances = out
             fault_metrics = ()
-        return final, opt_final, m_final, f_final, variances[-1], fault_metrics
+        if self.defense is not None:
+            # [interval, 5] per-iteration observations -> the [6] round
+            # vector.  Transitions count every rung move including the
+            # round boundary (pre-round rung from the INCOMING policy
+            # state), so a round that opens with an escalation reports it
+            rung_in = defense_state[1][0].astype(jnp.float32)
+            rung_path = jnp.concatenate([rung_in[None], dms[:, 0]])
+            defense_metrics = jnp.stack([
+                dms[-1, 0],                              # rung at round end
+                jnp.max(dms[:, 1]),                      # max flagged
+                jnp.sum(dms[:, 2]),                      # suspicious iters
+                jnp.max(dms[:, 3]),                      # max score
+                jnp.max(dms[:, 4]),                      # max cusum
+                jnp.sum(jnp.abs(jnp.diff(rung_path))),   # transitions
+            ])
+        else:
+            defense_metrics = ()
+        return (
+            final, opt_final, m_final, f_final, d_final, a_final,
+            variances[-1], fault_metrics, defense_metrics,
+        )
 
     def _build_round_fn(self):
         return self._round_core
@@ -663,24 +815,29 @@ class FedTrainer:
         base_key = self._base_key
 
         def multi_fn(
-            flat_params, opt_state, client_m, fault_state, rounds,
-            x_train, y_train,
+            flat_params, opt_state, client_m, fault_state, defense_state,
+            attack_iter, rounds, x_train, y_train,
         ):
             def body(carry, r):
-                fp, os, cm, fs = carry
-                fp, os, cm, fs, var, fm = self._round_core(
-                    fp, os, cm, fs, jax.random.fold_in(base_key, r),
+                fp, os, cm, fs, ds, ai = carry
+                fp, os, cm, fs, ds, ai, var, fm, dm = self._round_core(
+                    fp, os, cm, fs, ds, ai, jax.random.fold_in(base_key, r),
                     x_train, y_train,
                 )
-                return (fp, os, cm, fs), (var, fm)
+                return (fp, os, cm, fs, ds, ai), (var, fm, dm)
 
-            (final, opt_final, m_final, f_final), (variances, fms) = (
-                jax.lax.scan(
-                    body, (flat_params, opt_state, client_m, fault_state),
-                    rounds,
-                )
+            (final, opt_final, m_final, f_final, d_final, a_final), (
+                variances, fms, dms
+            ) = jax.lax.scan(
+                body,
+                (flat_params, opt_state, client_m, fault_state,
+                 defense_state, attack_iter),
+                rounds,
             )
-            return final, opt_final, m_final, f_final, variances, fms
+            return (
+                final, opt_final, m_final, f_final, d_final, a_final,
+                variances, fms, dms,
+            )
 
         return multi_fn
 
@@ -743,10 +900,12 @@ class FedTrainer:
         round_key = jax.random.fold_in(self._base_key, round_idx)
         (
             self.flat_params, self.server_opt_state, self.client_m,
-            self.fault_state, variance, self.last_fault_metrics,
+            self.fault_state, self.defense_state, self.attack_iter,
+            variance, self.last_fault_metrics, self.last_defense_metrics,
         ) = self._round_fn(
             self.flat_params, self.server_opt_state, self.client_m,
-            self.fault_state, round_key, self.x_train, self.y_train,
+            self.fault_state, self.defense_state, self.attack_iter,
+            round_key, self.x_train, self.y_train,
         )
         return variance
 
@@ -761,15 +920,20 @@ class FedTrainer:
         rounds = jnp.arange(start_round, start_round + num_rounds, dtype=jnp.int32)
         (
             self.flat_params, self.server_opt_state, self.client_m,
-            self.fault_state, variances, fms,
+            self.fault_state, self.defense_state, self.attack_iter,
+            variances, fms, dms,
         ) = self._multi_round_fn(
             self.flat_params, self.server_opt_state, self.client_m,
-            self.fault_state, rounds, self.x_train, self.y_train,
+            self.fault_state, self.defense_state, self.attack_iter,
+            rounds, self.x_train, self.y_train,
         )
-        # [num_rounds, 4] under faults (the LAST round's row is what
-        # run_round would have reported); () otherwise
+        # [num_rounds, 4] / [num_rounds, 6] stacked rows (the LAST round's
+        # row is what run_round would have reported); () when off
         self.last_fault_metrics = (
             fms[-1] if self.fault is not None else ()
+        )
+        self.last_defense_metrics = (
+            dms[-1] if self.defense is not None else ()
         )
         return variances
 
@@ -823,6 +987,14 @@ class FedTrainer:
             paths["faultErasedPath"] = []
             paths["faultCorruptPath"] = []
             paths["effectiveKPath"] = []
+        prev_rung = None
+        if self.defense is not None:
+            # per-round defense observability (defense/events.PATH_KEYS):
+            # rung, flagged clients, suspicious iterations, score/CUSUM
+            # maxima and intra-round transitions
+            for path_key in defense_lib.events.PATH_KEYS.values():
+                paths[path_key] = []
+            prev_rung = int(self.defense_state[1][0])
         log(
             f"[0/{cfg.rounds}](interval: {cfg.display_interval}) "
             f"train: loss={tr_loss:.4f} acc={tr_acc:.4f} "
@@ -871,6 +1043,25 @@ class FedTrainer:
                 var_str += (
                     f" effK={eff_k:.0f} drop={dropped:.0f} "
                     f"erase={erased:.0f} corrupt={corrupt:.0f}"
+                )
+            if self.defense is not None:
+                dmetrics = defense_lib.events.round_metrics(
+                    self.last_defense_metrics
+                )
+                for dkey, path_key in defense_lib.events.PATH_KEYS.items():
+                    paths[path_key].append(dmetrics[dkey])
+                agg_name = defense_lib.events.active_agg(
+                    self.defense.mode, self.defense.ladder,
+                    int(dmetrics["rung"]), cfg.agg,
+                )
+                defense_lib.events.emit_round(
+                    obs, r, mode=self.defense.mode, agg=agg_name,
+                    metrics=dmetrics, prev_rung=prev_rung,
+                )
+                prev_rung = int(dmetrics["rung"])
+                var_str += (
+                    f" rung={int(dmetrics['rung'])}({agg_name}) "
+                    f"flag={dmetrics['flagged']:.0f}"
                 )
             obs.round(
                 r,

@@ -41,6 +41,14 @@ REFERENCE_KEY_MAP = {
     "erased": "faultErasedPath",
     "corrupt": "faultCorruptPath",
     "effective_k": "effectiveKPath",
+    # defense-event fields (kind "defense"; defense/events.PATH_KEYS is the
+    # authoritative copy — tests/test_defense.py pins the two in sync)
+    "rung": "defenseRungPath",
+    "flagged": "defenseFlaggedPath",
+    "suspicious_iters": "defenseSuspiciousPath",
+    "score_max": "defenseScorePath",
+    "cusum_max": "defenseCusumPath",
+    "transitions": "defenseTransitionsPath",
 }
 
 # per-kind required fields (beyond the reserved v/kind/ts trio); kinds not
@@ -51,6 +59,7 @@ _REQUIRED: Dict[str, tuple] = {
     "span": ("name", "ms"),
     "retrace": ("counts", "steady_state_ok"),
     "run_end": ("elapsed_secs", "rounds_run"),
+    "defense": ("round", "rung", "flagged"),
 }
 
 

@@ -69,7 +69,7 @@ def _finite_centroid(wmatrix, finite):
     ) / jnp.maximum(jnp.sum(finite), 1.0)
 
 
-@AGGREGATORS.register("mean")
+@AGGREGATORS.register("mean", extra_args=())
 def mean(wmatrix: jnp.ndarray, *, degraded: bool = False, **_) -> jnp.ndarray:
     """Column mean (reference ``mean``, ``:186-187``).
 
@@ -177,11 +177,17 @@ def _select_trimmed_mean(wmatrix: jnp.ndarray, b: int) -> jnp.ndarray:
 def supports_fused_epilogue(name: str) -> bool:
     """Aggregators whose epilogue the fused dispatch below accelerates (and
     into whose stack read the OMA prepass may be folded).  gm already owns
-    its channel in-kernel (``aircomp_weiszfeld_step``)."""
-    return name in ("median", "trimmed_mean")
+    its channel in-kernel (``aircomp_weiszfeld_step``).  Read from the
+    registration metadata — one source of truth shared with the defense
+    escalation ladder's branch table — not a name list."""
+    return bool(AGGREGATORS.meta(name).get("supports_fused_epilogue", False))
 
 
-@AGGREGATORS.register("median")
+@AGGREGATORS.register(
+    "median",
+    supports_fused_epilogue=True,
+    extra_args=("impl", "fused_epilogue", "oma_key", "noise_var"),
+)
 def median(
     wmatrix: jnp.ndarray,
     *,
@@ -240,7 +246,13 @@ def median(
     return srt[(k - 1) // 2]
 
 
-@AGGREGATORS.register("trimmed_mean")
+@AGGREGATORS.register(
+    "trimmed_mean",
+    supports_fused_epilogue=True,
+    extra_args=(
+        "trim_ratio", "beta", "impl", "fused_epilogue", "oma_key", "noise_var",
+    ),
+)
 def trimmed_mean(
     wmatrix: jnp.ndarray, *, trim_ratio: float = 0.1,
     beta: Optional[int] = None, degraded: bool = False,
@@ -391,7 +403,13 @@ def krum_scores_degraded(
     return jnp.where(finite, scores, jnp.inf)
 
 
-@AGGREGATORS.register("krum", aliases=("Krum",))
+@AGGREGATORS.register(
+    "krum",
+    aliases=("Krum",),
+    needs_honest_size=True,
+    krum_like=True,
+    extra_args=(),
+)
 def krum(
     wmatrix: jnp.ndarray, *, honest_size: int, degraded: bool = False, **_
 ) -> jnp.ndarray:
@@ -409,7 +427,9 @@ def krum(
     return wmatrix[jnp.argmin(scores)]
 
 
-@AGGREGATORS.register("multi_krum")
+@AGGREGATORS.register(
+    "multi_krum", needs_honest_size=True, krum_like=True, extra_args=("m",)
+)
 def multi_krum(
     wmatrix: jnp.ndarray, *, honest_size: int, m: Optional[int] = None,
     degraded: bool = False, **_
@@ -465,7 +485,11 @@ def multi_krum(
     )
 
 
-@AGGREGATORS.register("dnc")
+@AGGREGATORS.register(
+    "dnc",
+    needs_honest_size=True,
+    extra_args=("dnc_iters", "dnc_sub_dim", "dnc_c", "key"),
+)
 def dnc(
     wmatrix: jnp.ndarray,
     *,
@@ -554,7 +578,11 @@ def dnc(
     return jnp.where(count > 0, mean_kept, _finite_centroid(wmatrix, finite))
 
 
-@AGGREGATORS.register("signmv")
+@AGGREGATORS.register(
+    "signmv",
+    owns_channel=True,
+    extra_args=("guess", "key", "noise_var", "sign_eta"),
+)
 def sign_majority_vote(
     wmatrix: jnp.ndarray,
     *,
@@ -621,7 +649,9 @@ def sign_majority_vote(
     return _blocked_columns((wmatrix, guess, noise), tail)
 
 
-@AGGREGATORS.register("cclip")
+@AGGREGATORS.register(
+    "cclip", extra_args=("guess", "clip_tau", "clip_iters")
+)
 def centered_clip(
     wmatrix: jnp.ndarray,
     *,
@@ -672,7 +702,7 @@ def centered_clip(
     return v
 
 
-@AGGREGATORS.register("bulyan")
+@AGGREGATORS.register("bulyan", needs_honest_size=True, extra_args=())
 def bulyan(
     wmatrix: jnp.ndarray, *, honest_size: int, degraded: bool = False, **_
 ) -> jnp.ndarray:
@@ -806,7 +836,9 @@ def _weiszfeld_dists(wmatrix, guess):
     return jnp.maximum(DIST_CLAMP, d)
 
 
-@AGGREGATORS.register("gm2")
+@AGGREGATORS.register(
+    "gm2", extra_args=("guess", "maxiter", "tol", "impl")
+)
 def gm2(
     wmatrix: jnp.ndarray,
     *,
@@ -864,7 +896,13 @@ def gm2(
     return final
 
 
-@AGGREGATORS.register("gm")
+@AGGREGATORS.register(
+    "gm",
+    owns_channel=True,
+    extra_args=(
+        "guess", "key", "noise_var", "maxiter", "tol", "p_max", "impl",
+    ),
+)
 def gm(
     wmatrix: jnp.ndarray,
     *,
@@ -959,5 +997,7 @@ def needs_oma_prepass(name: str) -> bool:
     of the message stack before aggregating; ``gm`` instead runs its own OMA2
     inside each Weiszfeld step.  ``signmv`` (beyond-reference) also owns its
     channel: the sign votes are the over-the-air transmission, so receiver
-    noise lands on the vote sum, not on pre-sign weights."""
-    return name not in ("gm", "signmv")
+    noise lands on the vote sum, not on pre-sign weights.  The rule reads the
+    ``owns_channel`` registration metadata (shared with the defense ladder
+    validation) instead of a hardcoded name pair."""
+    return not AGGREGATORS.meta(name).get("owns_channel", False)

@@ -58,6 +58,14 @@ class AttackSpec:
     # name of the message_fn keyword its magnitude knob binds to (alie: z,
     # ipm: eps, gaussian: sigma); None = the attack has no scalar knob
     param_name: Optional[str] = None
+    # delayed onset: the round index (0-based, in harness rounds) at which
+    # the Byzantine clients switch from honest behavior to this attack —
+    # the "stay silent, then strike" threat model adaptive defenses exist
+    # for.  Spelled ``name@round`` on the CLI (resolve below); None =
+    # attack from round 0, the classic static threat model.  The trainer
+    # gates every attack surface on a carried iteration counter, so before
+    # onset the Byzantine rows are bit-identical to honest ones.
+    onset_round: Optional[int] = None
 
     def apply_data(self, x, y, num_classes: int):
         if self.data_fn is None:
@@ -234,7 +242,29 @@ ATTACKS.register("minsum")(
 
 
 def resolve(name: Optional[str]) -> Optional[AttackSpec]:
-    """Look up an attack by CLI name; None means no attack (all honest)."""
+    """Look up an attack by CLI name; None means no attack (all honest).
+
+    ``name@R`` wraps the registered attack with a delayed onset at round R
+    (e.g. ``signflip@10``: Byzantine clients behave honestly for rounds
+    0..9, then sign-flip) — the time-varying threat model the adaptive
+    defense subsystem reacts to.  The wrapped spec keeps the full spelled
+    name so titles/records distinguish it from the static attack.
+    """
     if name is None:
         return None
+    if "@" in name:
+        import dataclasses
+
+        base_name, _, onset_str = name.partition("@")
+        base = ATTACKS.get(base_name)
+        try:
+            onset = int(onset_str)
+        except ValueError:
+            raise ValueError(
+                f"attack onset {name!r}: expected '<attack>@<round>' with an "
+                f"integer round, got {onset_str!r}"
+            ) from None
+        if onset < 0:
+            raise ValueError(f"attack onset round must be >= 0, got {onset}")
+        return dataclasses.replace(base, name=name, onset_round=onset)
     return ATTACKS.get(name)

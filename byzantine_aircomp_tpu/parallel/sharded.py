@@ -119,6 +119,16 @@ class ShardedFedTrainer(FedTrainer):
             if not isinstance(ge_bad, tuple):
                 ge_bad = jax.device_put(ge_bad, repl)
             self.fault_state = (stale, ge_bad)
+        if self.defense is not None:
+            # defense carry: [K] detector baselines and the scalar policy
+            # counters all replicate (tiny; the scored stack is already
+            # resident per-shard, and the lax.switch rung must agree on
+            # every device)
+            self.defense_state = jax.tree.map(
+                lambda leaf: jax.device_put(leaf, repl), self.defense_state
+            )
+        if not isinstance(self.attack_iter, tuple):
+            self.attack_iter = jax.device_put(self.attack_iter, repl)
         # server-opt state: [d]-shaped leaves follow the params layout,
         # scalars (e.g. adam's count) replicate
         self.server_opt_state = jax.tree.map(

@@ -14,22 +14,39 @@ from typing import Callable, Dict, Iterable, Optional
 
 
 class Registry:
-    """A simple name -> object registry with decorator support."""
+    """A simple name -> object registry with decorator support.
+
+    ``register`` accepts arbitrary static metadata keywords
+    (``needs_honest_size``, ``supports_fused_epilogue``, ``owns_channel``,
+    ``extra_args``, ...) stored per entry and shared by aliases, so gates
+    that used to string-match names (the fused-epilogue dispatch, the
+    channel prepass rule, the defense escalation-ladder validation) read
+    one source of truth via :meth:`meta`.
+    """
 
     def __init__(self, kind: str):
         self.kind = kind
         self._entries: Dict[str, Callable] = {}
+        self._meta: Dict[str, dict] = {}
 
-    def register(self, name: Optional[str] = None, *, aliases: Iterable[str] = ()):
+    def register(
+        self,
+        name: Optional[str] = None,
+        *,
+        aliases: Iterable[str] = (),
+        **meta,
+    ):
         def wrap(fn: Callable) -> Callable:
             key = name or fn.__name__
             if key in self._entries:
                 raise KeyError(f"duplicate {self.kind} registration: {key!r}")
             self._entries[key] = fn
+            self._meta[key] = meta
             for alias in aliases:
                 if alias in self._entries:
                     raise KeyError(f"duplicate {self.kind} alias: {alias!r}")
                 self._entries[alias] = fn
+                self._meta[alias] = meta
             return fn
 
         return wrap
@@ -42,6 +59,13 @@ class Registry:
             raise KeyError(
                 f"unknown {self.kind} {name!r}; known: {known}"
             ) from None
+
+    def meta(self, name: str) -> dict:
+        """Static metadata attached at registration ({} when none given).
+        Raises like :meth:`get` on unknown names so a typo can't read as
+        an all-defaults entry."""
+        self.get(name)
+        return self._meta.get(name, {})
 
     def names(self):
         return sorted(self._entries)

@@ -1,0 +1,397 @@
+"""Online defense subsystem: detector oracle, policy hysteresis, ladder
+validation, delayed-onset attacks, and the end-to-end escalation acceptance
+path.
+
+The detector/policy math is pinned against a NumPy oracle (mirroring
+``defense/scores.py`` line for line), the escalation story runs through the
+REAL harness under ``signflip@R``, and the ``retrace``/``lowering``-named
+test extends the CI retrace gate to the adaptive-defense carry.
+"""
+
+import importlib.util
+import json
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu import defense as defense_lib
+from byzantine_aircomp_tpu import obs as obs_lib
+from byzantine_aircomp_tpu.analysis import adaptive_matrix
+from byzantine_aircomp_tpu.defense import events as defense_events
+from byzantine_aircomp_tpu.fed import harness
+from byzantine_aircomp_tpu.fed.config import FedConfig
+from byzantine_aircomp_tpu.obs import events as obs_events
+from byzantine_aircomp_tpu.ops import attacks as attack_lib
+
+# ------------------------------------------------------- detector oracle
+
+
+def _oracle_step(state, score, finite, p):
+    """NumPy mirror of defense/scores.py::detector_update."""
+    step, ema, dev, cusum = state
+    warm = step >= p.warmup
+    sigma = dev + p.eps
+    resid = score - ema
+    z = resid / sigma
+    clipped = np.clip(resid, -p.clip * sigma, p.clip * sigma)
+    ema_new = score if step == 0 else ema + p.alpha * clipped
+    dev_new = (
+        np.abs(score) + p.eps
+        if step == 0
+        else (1.0 - p.alpha) * dev + p.alpha * np.abs(clipped)
+    )
+    z_c = np.clip(z, -p.clip, p.clip)
+    cusum_new = (
+        np.minimum(
+            np.maximum(cusum + z_c - p.drift, 0.0), 2.0 * p.cusum_thresh
+        )
+        if warm
+        else np.zeros_like(cusum)
+    )
+    flags = warm & ((z > p.z_thresh) | (cusum_new > p.cusum_thresh)) & finite
+    ema = np.where(finite, ema_new, ema)
+    dev = np.where(finite, dev_new, dev)
+    cusum = np.where(finite, cusum_new, cusum)
+    return (step + 1, ema, dev, cusum), flags
+
+
+def test_detector_update_matches_numpy_oracle():
+    k = 8
+    p = defense_lib.DetectorParams(warmup=3)
+    rng = np.random.default_rng(0)
+    det = defense_lib.init_detector(k)
+    oracle = (0, np.zeros(k, np.float32), np.zeros(k, np.float32),
+              np.zeros(k, np.float32))
+    for t in range(14):
+        score = rng.gamma(2.0, 0.05, size=k).astype(np.float32)
+        if t >= 6:
+            score[-2:] += 3.0  # two clients start striking
+        finite = np.ones(k, bool)
+        if t in (4, 9):
+            finite[0] = False  # a deep-fade round: row 0 holds state
+        det, flags = defense_lib.detector_update(
+            det, jnp.asarray(score), jnp.asarray(finite), p
+        )
+        oracle, oflags = _oracle_step(oracle, score, finite, p)
+        assert int(det[0]) == oracle[0]
+        for got, want in zip(det[1:], oracle[1:]):
+            np.testing.assert_allclose(
+                np.asarray(got), want, rtol=1e-5, atol=1e-6
+            )
+        np.testing.assert_array_equal(np.asarray(flags), oflags)
+    # the attack must actually have been flagged, and only by the attackers
+    assert oflags[-2:].all() and not oflags[:-2].any()
+
+
+def test_detector_cusum_saturates_for_deescalation():
+    # an attacker striking HARD for many iterations must not bank unbounded
+    # CUSUM: once it goes quiet, flags must clear within a bounded number
+    # of clean iterations (else the policy's down-counter never starts)
+    k = 4
+    p = defense_lib.DetectorParams(warmup=2)
+    det = defense_lib.init_detector(k)
+    quiet = jnp.full((k,), 0.05, jnp.float32)
+    loud = quiet.at[-1].set(50.0)
+    finite = jnp.ones(k, bool)
+    for _ in range(5):  # seed honest baselines first
+        det, _ = defense_lib.detector_update(det, quiet, finite, p)
+    for _ in range(40):
+        det, flags = defense_lib.detector_update(det, loud, finite, p)
+    assert bool(flags[-1])
+    assert float(det[3][-1]) <= 2.0 * p.cusum_thresh + 1e-5
+    clean_until_clear = None
+    for t in range(60):
+        det, flags = defense_lib.detector_update(det, quiet, finite, p)
+        if not bool(flags[-1]):
+            clean_until_clear = t
+            break
+    # 2*thresh of banked CUSUM decays by >= drift per clean step
+    assert clean_until_clear is not None
+    assert clean_until_clear <= int(2 * p.cusum_thresh / p.drift) + 2
+
+
+def test_client_scores_separate_signflip_from_honest():
+    w, base = adaptive_matrix.honest_stack()
+    b = adaptive_matrix.B
+    w_att = w.at[-b:].set(-w[-b:])  # signflip: byz rows transmit -w
+    score, finite = defense_lib.client_scores(w_att, base)
+    assert bool(finite.all())
+    assert float(jnp.min(score[-b:])) > 10 * float(jnp.max(score[:-b]))
+    # non-finite rows carry no evidence: score exactly 0, mask cleared
+    w_nan = w_att.at[0].set(jnp.nan)
+    score_n, finite_n = defense_lib.client_scores(w_nan, base)
+    assert not bool(finite_n[0]) and float(score_n[0]) == 0.0
+
+
+# ----------------------------------------------------- policy hysteresis
+
+
+def _run_policy(flag_seq, p):
+    pol = defense_lib.init_policy()
+    rungs = []
+    for n in flag_seq:
+        pol, _ = defense_lib.policy_update(pol, jnp.int32(n), p)
+        rungs.append(int(pol[0]))
+    return pol, rungs
+
+
+def test_policy_escalates_deescalates_with_hysteresis():
+    p = defense_lib.PolicyParams(up_n=2, down_m=3, min_flagged=1, n_rungs=3)
+    # two suspicious iterations per rung up; the streak resets on consume
+    _, rungs = _run_policy([1, 1, 1, 1, 1, 1], p)
+    assert rungs == [0, 1, 1, 2, 2, 2]  # clamped at the top rung
+    # three clean iterations per rung down, from the top
+    _, rungs = _run_policy([1, 1, 1, 1] + [0] * 7, p)
+    assert rungs[3] == 2
+    assert rungs[4:] == [2, 2, 1, 1, 1, 0, 0]
+    # alternating flags never build the up-streak: no escalation
+    _, rungs = _run_policy([1, 0] * 6, p)
+    assert rungs == [0] * 12
+    # rung 0 never de-escalates below 0
+    _, rungs = _run_policy([0] * 10, p)
+    assert rungs == [0] * 10
+
+
+def test_validate_ladder_rejects_bad_ladders():
+    with pytest.raises(ValueError, match=">= 2 rungs"):
+        defense_lib.validate_ladder(("mean",), None)
+    with pytest.raises(KeyError):
+        defense_lib.validate_ladder(("mean", "nosuchagg"), None)
+    with pytest.raises(ValueError, match="owns its channel"):
+        defense_lib.validate_ladder(("mean", "gm"), None)
+    with pytest.raises(ValueError, match="must equal --agg"):
+        defense_lib.validate_ladder(("mean", "trimmed_mean"), "trimmed_mean")
+    # monitor mode (no base agg) accepts any non-owning ladder
+    defense_lib.validate_ladder(("mean", "trimmed_mean", "multi_krum"), None)
+    defense_lib.validate_ladder(("mean", "trimmed_mean"), "mean")
+
+
+# -------------------------------------------------- delayed-onset attacks
+
+
+def test_attack_onset_resolve_syntax():
+    spec = attack_lib.resolve("signflip@10")
+    assert spec.onset_round == 10 and spec.name == "signflip@10"
+    assert spec.message_fn is attack_lib.resolve("signflip").message_fn
+    assert attack_lib.resolve("signflip").onset_round is None
+    with pytest.raises(ValueError, match="integer round"):
+        attack_lib.resolve("signflip@soon")
+    with pytest.raises(ValueError, match=">= 0"):
+        attack_lib.resolve("signflip@-1")
+    with pytest.raises(KeyError):
+        attack_lib.resolve("nosuchattack@3")
+
+
+# --------------------------------------------------- config-level wiring
+
+
+def test_defense_knobs_require_defense_on():
+    # validation runs at trainer/harness construction (cfg.validate())
+    with pytest.raises(AssertionError, match="require --defense"):
+        FedConfig(defense="off", defense_up=5).validate()
+    with pytest.raises(AssertionError, match="full participation"):
+        FedConfig(defense="monitor", agg="mean", participation=0.5,
+                  honest_size=8).validate()
+    with pytest.raises(ValueError, match="must equal --agg"):
+        FedConfig(defense="adaptive").validate()  # default agg "gm"
+    FedConfig(defense="adaptive", agg="mean").validate()  # valid spelling
+
+
+def test_config_hash_off_matches_predefense_formula():
+    import dataclasses
+    import hashlib
+
+    cfg = FedConfig(agg="mean", honest_size=6, byz_size=2, rounds=3)
+    # recompute the hash exactly as pre-defense builds did: no defense
+    # fields existed, so they never entered the material
+    skip = (
+        "checkpoint_dir", "cache_dir", "profile_dir", "inherit", "rounds",
+        "obs_dir", "obs_stdout", "log_file", "quiet",
+    )
+    items = sorted(
+        (f.name, repr(getattr(cfg, f.name)))
+        for f in dataclasses.fields(cfg)
+        if f.name not in skip + ("defense",) + FedConfig._DEFENSE_KNOBS
+    )
+    legacy = hashlib.sha256(repr(items).encode()).hexdigest()[:8]
+    assert harness.config_hash(cfg) == legacy
+    # turning the defense on must change the hash (different program)
+    cfg_on = dataclasses.replace(cfg, defense="monitor")
+    assert harness.config_hash(cfg_on) != harness.config_hash(cfg)
+    # ...and defense knobs participate once the defense is on
+    cfg_on2 = dataclasses.replace(cfg, defense="monitor", defense_up=7)
+    assert harness.config_hash(cfg_on2) != harness.config_hash(cfg_on)
+
+
+def test_run_title_defense_suffix():
+    cfg = FedConfig(agg="mean", honest_size=6, byz_size=2)
+    assert "def" not in harness.run_title(cfg)
+    cfg_d = FedConfig(agg="mean", honest_size=6, byz_size=2,
+                      defense="adaptive", defense_up=2)
+    title = harness.run_title(cfg_d)
+    assert title.endswith("_defadaptive_defenseup2")
+
+
+def test_path_keys_pinned_to_obs_reference_map():
+    # defense/events.PATH_KEYS is authoritative; obs/events carries a copy
+    # for the schema docs — this pin is what lets them never drift
+    for field, key in defense_events.PATH_KEYS.items():
+        assert obs_events.REFERENCE_KEY_MAP.get(field) == key, field
+    assert obs_events._REQUIRED["defense"] == ("round", "rung", "flagged")
+    assert set(defense_events.METRIC_KEYS) == set(defense_events.PATH_KEYS)
+
+
+# ------------------------------------------------- end-to-end escalation
+
+
+def _cfg(**kw):
+    # K = 7 (not a multiple of the 8-device test mesh) keeps these runs on
+    # the single-device trainer, matching test_obs.py's harness runs
+    base = dict(
+        dataset="mnist", honest_size=5, byz_size=2, rounds=4,
+        display_interval=10, batch_size=16, agg="mean", eval_train=False,
+        attack="signflip@1",
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+@pytest.fixture
+def synthetic_mnist(monkeypatch):
+    import byzantine_aircomp_tpu.data.datasets as dl
+
+    orig = dl.load
+    monkeypatch.setattr(
+        dl, "load",
+        lambda name, **kw: orig(name, synthetic_train=1500, synthetic_val=300),
+    )
+
+
+def test_adaptive_escalates_under_delayed_signflip_and_beats_static_mean(
+    tmp_path, synthetic_mnist
+):
+    obs_dir = str(tmp_path / "obs")
+    cfg = _cfg(defense="adaptive", defense_up=3, obs_dir=obs_dir)
+    rec = harness.run(cfg, record_in_file=False)
+
+    rungs = rec["defenseRungPath"]
+    # rounds 0 (pre-onset): honest byz rows, rung stays on mean
+    assert rungs[0] == 0.0
+    # within the hysteresis window after onset (warmup=5 + up_n=3 < one
+    # 10-iteration round) the policy must have left the base rung...
+    assert rungs[1] >= 1.0
+    # ...flagging actual attackers along the way
+    assert max(rec["defenseFlaggedPath"]) >= 1.0
+    assert max(rungs) >= 1.0 and rec["defense"] == "adaptive"
+
+    # the defense event stream tells the same story: an escalate
+    # transition no later than the round after onset
+    events_file = obs_lib.events_path(obs_dir, harness.ckpt_title(cfg))
+    events = [json.loads(line) for line in open(events_file)]
+    d_events = [e for e in events if e["kind"] == "defense"]
+    assert [e["round"] for e in d_events] == [0, 1, 2, 3]
+    esc = [e for e in d_events if e.get("transition") == "escalate"]
+    assert esc and esc[0]["round"] == 1
+    for e in d_events:
+        obs_lib.validate_event(e)
+        assert e["agg"] == cfg.defense_ladder_names()[e["rung"]]
+
+    # acceptance: adaptive beats the static base aggregator under the same
+    # delayed attack (signflip byz rows transmit -w; their mean halves the
+    # params every aggregation, which escalation stops)
+    rec_static = harness.run(_cfg(), record_in_file=False)
+    assert rec["valAccPath"][-1] > rec_static["valAccPath"][-1]
+
+
+def test_monitor_mode_observes_without_switching(tmp_path, synthetic_mnist):
+    rec = harness.run(
+        _cfg(defense="monitor", rounds=2), record_in_file=False
+    )
+    # the rung tracks what adaptive WOULD do...
+    assert max(rec["defenseRungPath"]) >= 1.0
+    # ...but the trajectory is the static aggregator's: bit-identical to a
+    # plain run once the defense-only keys are stripped
+    rec_off = harness.run(_cfg(rounds=2), record_in_file=False)
+    rec = dict(rec)
+    for key in (
+        ["defense", "defenseLadder", "roundsPerSec"]
+        + list(defense_events.PATH_KEYS.values())
+    ):
+        rec.pop(key)
+    rec_off = dict(rec_off)
+    rec_off.pop("roundsPerSec")
+    assert pickle.dumps(rec) == pickle.dumps(rec_off)
+
+
+def test_adaptive_defense_retrace_single_lowering_with_onset(
+    tmp_path, synthetic_mnist
+):
+    # CI retrace gate (-k "retrace or lowering"): the defense carry and the
+    # onset-gated attack must not add a second lowering of the round fn
+    cfg = _cfg(defense="adaptive", rounds=3, obs_dir=str(tmp_path / "obs"))
+    harness.run(cfg, record_in_file=False)
+    events_file = obs_lib.events_path(
+        str(tmp_path / "obs"), harness.ckpt_title(cfg)
+    )
+    events = [json.loads(line) for line in open(events_file)]
+    (ret,) = [e for e in events if e["kind"] == "retrace"]
+    assert ret["counts"]["round_fn"] == 1 and ret["steady_state_ok"]
+    rounds = [e for e in events if e["kind"] == "round"]
+    assert [e["compiled"] for e in rounds] == [True, False, False]
+
+
+# -------------------------------------------------- adaptive matrix smoke
+
+
+def test_adaptive_matrix_smoke_cell():
+    cell = adaptive_matrix.simulate_cell(
+        "signflip", "adaptive", iters=30, onset=5, stop=20,
+        det=defense_lib.DetectorParams(warmup=3),
+    )
+    assert cell["detect_iter"] is not None and cell["detect_iter"] <= 3
+    assert cell["max_rung"] >= 1
+    # while the attack ran, the escalated aggregate stayed near the honest
+    # centroid (the number a successful escalation must keep small)
+    assert cell["agg_err"] < 0.05
+    # data-level attacks legitimately show nothing at the stack level
+    quiet = adaptive_matrix.simulate_cell(
+        "classflip", "monitor", iters=12, onset=3, stop=9
+    )
+    assert quiet["detect_iter"] is None and quiet["max_rung"] == 0
+
+
+# ----------------------------------------------- driver deadline hygiene
+
+
+def _load_graft_entry():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry_under_test", os.path.join(repo, "__graft_entry__.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_graft_entry_deadline_records_skip(monkeypatch, capsys):
+    mod = _load_graft_entry()
+    monkeypatch.setenv("GRAFT_RUN_DEADLINE_SECS", "20")
+    # 20s - 10s spawn margin < 30s child floor: the stage must be SKIPPED
+    # with a machine-readable record, not spawned into a future rc=124
+    mod.dryrun_multichip(4, probe={"backend": "cpu", "n": 0})
+    out = capsys.readouterr().out
+    skips = [
+        json.loads(line) for line in out.splitlines()
+        if line.startswith("{")
+    ]
+    (skip,) = skips
+    assert skip["skipped"] and skip["reason"] == "deadline"
+    assert skip["n_devices"] == 4 and skip["deadline_secs"] == 20.0
+    assert skip["tail"]  # the rolling log tail rides along
+    # <= 0 disables the deadline entirely
+    monkeypatch.setenv("GRAFT_RUN_DEADLINE_SECS", "0")
+    assert mod._Deadline().remaining() == float("inf")

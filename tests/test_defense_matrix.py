@@ -14,21 +14,18 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# the smoke-stack fixture lives with the adaptive-defense matrix tool so
+# the analysis grid and this test matrix exercise the SAME regime
+from byzantine_aircomp_tpu.analysis.adaptive_matrix import (
+    B,
+    D,
+    HONEST,
+    K,
+    honest_stack as _stack,
+)
 from byzantine_aircomp_tpu.ops import aggregators as agg_lib
 from byzantine_aircomp_tpu.ops import attacks as attack_lib
 from byzantine_aircomp_tpu.registry import AGGREGATORS, ATTACKS
-
-K, B, D = 16, 3, 24
-HONEST = K - B
-
-
-def _stack():
-    key = jax.random.PRNGKey(0)
-    base = 0.05 * jax.random.normal(jax.random.fold_in(key, 1), (D,))
-    w = base[None, :] + 1e-3 * jax.random.normal(
-        jax.random.fold_in(key, 2), (K, D)
-    )
-    return w.astype(jnp.float32), base.astype(jnp.float32)
 
 
 @pytest.mark.parametrize("attack_name", sorted(ATTACKS.names()))

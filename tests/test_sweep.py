@@ -155,11 +155,23 @@ def test_sweep_forwards_every_shared_knob():
         "corrupt_prob": 0.02,
         "corrupt_mode": "saturate",
         "corrupt_size": 1,
+        "defense": "monitor",
+        "defense_ladder": "mean,trimmed_mean",
+        "defense_warmup": 2,
+        "defense_alpha": 0.2,
+        "defense_drift": 0.25,
+        "defense_cusum": 5.0,
+        "defense_z": 3.0,
+        "defense_up": 2,
+        "defense_down": 10,
+        "defense_min_flagged": 2,
     }
     # the fault knobs require --fault and full participation
-    # (config.validate), so they ride a second, separate sweep cell
+    # (config.validate), so they ride a second, separate sweep cell;
+    # same for the defense knobs (--defense + full participation)
     fault_dests = {"fault", "dropout_prob", "fade_floor", "csi_std",
                    "corrupt_prob", "corrupt_mode", "corrupt_size"}
+    defense_dests = {d for d in samples if d.startswith("defense")}
     probe = argparse.ArgumentParser()
     add_knob_flags(probe)
     flag_of = {
@@ -176,7 +188,12 @@ def test_sweep_forwards_every_shared_knob():
     base = ["--aggs", "mean", "--attacks", "none", "--K", "8", "--B", "0",
             "--rounds", "1", "--interval", "2", "--batch-size", "8"]
     orig = sweep_mod.run_sweep
-    for group in (set(flag_of) - fault_dests, fault_dests):
+    groups = (
+        set(flag_of) - fault_dests - defense_dests,
+        fault_dests,
+        defense_dests,
+    )
+    for group in groups:
         argv = list(base)
         for dest in sorted(group):
             argv += [flag_of[dest], str(samples[dest])]
