@@ -5,21 +5,32 @@ BASELINE.json target: >= 50 rounds/sec (a "round" = displayInterval = 10
 global iterations, the reference's unit at MNIST_Air_weight.py:286-287).
 ``vs_baseline`` is value / 50.
 
-Prints exactly ONE JSON line on stdout; progress goes to stderr.
+Prints exactly ONE JSON line on stdout; progress goes to stderr.  The
+line is a schema-versioned ``bench`` event (``obs.events.make_event``,
+emitted through ``obs.sinks.StdoutSink``) carrying explicit provenance:
+``platform`` (what actually ran), ``fallback_reason`` (why the
+accelerator path was abandoned, null on a clean run), ``relay`` (the
+tunnel-relay diagnosis when one was made) and the config fields
+(``k``/``b``/``agg``/``attack``/``dataset``/``model``) the perf ledger
+keys baselines on (``obs/ledger.py``; gate with
+``analysis/perf_gate.py``).  Set ``BENCH_LEDGER=path`` to also append
+the row to that ledger, and ``BENCH_TINY=1`` for a CI-sized config
+(K=32, B=4).
 
 Staged, tunnel-proof harness (round-1 failure mode: a wedged axon relay
 blocks JAX backend init indefinitely -> 900 silent seconds -> watchdog
 rc=3 with no diagnostics):
 
-  stage 1  parent (never imports jax): probe backend init in a subprocess
-           with the inherited env, BENCH_PROBE_SECS timeout (default 120).
+  stage 1  parent (never initializes a backend): probe backend init in a
+           subprocess with the inherited env, BENCH_PROBE_SECS timeout
+           (default 120).
   stage 2a probe ok on an accelerator -> run the real bench in a child with
            the inherited env (BENCH_RUN_SECS, default 600).
   stage 2b probe wedged / CPU-only / accelerator child failed -> run a
            scrubbed-env CPU fallback (PALLAS_AXON_POOL_IPS unset so the
            axon sitecustomize never boots the tunnel; JAX_PLATFORMS=cpu)
-           with fewer timed rounds, and annotate the JSON line with
-           ``platform`` + ``error`` so the artifact is self-describing.
+           with fewer timed rounds, annotated with ``platform`` +
+           ``fallback_reason`` so the artifact is self-describing.
 
 Either way the driver gets one parseable JSON line, never a silent hang.
 """
@@ -34,15 +45,99 @@ import time
 
 TARGET_ROUNDS_PER_SEC = 50.0  # BASELINE.json north star (v5e-8, K=1000, B=100)
 
-K = 1000
-B = 100
 AGG = "gm2"
 ATTACK = "classflip"
-METRIC = f"fl_rounds_per_sec_K{K}_B{B}_{ATTACK}_{AGG}_mnist_mlp"
+
+
+def bench_params() -> dict:
+    """The benchmark configuration, env-tunable for CI smoke runs.
+
+    Default is the north-star config (K=1000, B=100); ``BENCH_TINY=1``
+    shrinks it to a CI-runnable size under a DIFFERENT metric name —
+    tiny rows must never average into the north-star baseline."""
+    if os.environ.get("BENCH_TINY"):
+        k, b = 32, 4
+    else:
+        k, b = 1000, 100
+    return {
+        "k": k,
+        "b": b,
+        "agg": AGG,
+        "attack": ATTACK,
+        "dataset": "mnist",
+        "model": "MLP",
+        "metric": f"fl_rounds_per_sec_K{k}_B{b}_{ATTACK}_{AGG}_mnist_mlp",
+    }
+
+
+# module-level aliases kept for external readers of the historical names
+_P = bench_params()
+K, B, METRIC = _P["k"], _P["b"], _P["metric"]
 
 
 def log(msg: str) -> None:
     print(f"bench: {msg}", file=sys.stderr, flush=True)
+
+
+def make_bench_row(
+    value: float,
+    *,
+    platform: str,
+    timed_rounds: int,
+    val_acc: float | None = None,
+    fallback_reason: str | None = None,
+    relay: str | None = None,
+    params: dict | None = None,
+) -> dict:
+    """One schema-versioned ``bench`` event row (the stdout contract)."""
+    from byzantine_aircomp_tpu.obs.events import make_event
+
+    p = params or bench_params()
+    row = make_event(
+        "bench",
+        metric=p["metric"],
+        value=round(value, 3),
+        unit="rounds/sec",
+        vs_baseline=round(value / TARGET_ROUNDS_PER_SEC, 4),
+        platform=platform,
+        timed_rounds=timed_rounds,
+        k=p["k"],
+        b=p["b"],
+        agg=p["agg"],
+        attack=p["attack"],
+        dataset=p["dataset"],
+        model=p["model"],
+        fallback_reason=fallback_reason,
+        relay=relay,
+    )
+    if val_acc is not None:
+        row["val_acc"] = round(float(val_acc), 4)
+    if fallback_reason is not None:
+        # historical field name, kept so existing BENCH_r*.json consumers
+        # (and PERFORMANCE.md narrative greps) keep working
+        row["error"] = fallback_reason
+    return row
+
+
+def emit_row(row: dict) -> None:
+    """The one machine-readable stdout line, through the shared sink."""
+    from byzantine_aircomp_tpu.obs.sinks import StdoutSink
+
+    StdoutSink().emit(row)
+    ledger_path = os.environ.get("BENCH_LEDGER")
+    if ledger_path and row.get("platform") not in (None, "none"):
+        from byzantine_aircomp_tpu.obs.ledger import PerfLedger, config_key
+
+        PerfLedger(ledger_path).append(
+            str(row["metric"]), float(row["value"]),
+            unit=str(row.get("unit", "")),
+            platform=str(row["platform"]),
+            key=config_key(row),
+            timed_rounds=row.get("timed_rounds"),
+            note="bench.py" + (" (fallback)" if row.get("fallback_reason")
+                              else ""),
+        )
+        log(f"appended row to ledger {ledger_path}")
 
 
 # --------------------------------------------------------------------------
@@ -50,8 +145,24 @@ def log(msg: str) -> None:
 # --------------------------------------------------------------------------
 
 def run_child() -> None:
+    from byzantine_aircomp_tpu.utils.env import condense_stderr_warnings
+
+    # the XLA machine-feature wall of text (one multi-KB line per compile)
+    # used to bury the progress log in BENCH_r*.json tails; full text goes
+    # to BENCH_LOG_FILE when set, stderr gets a one-line summary
+    restore_stderr = condense_stderr_warnings(
+        os.environ.get("BENCH_LOG_FILE", "")
+    )
+    try:
+        _run_child_inner()
+    finally:
+        restore_stderr()
+
+
+def _run_child_inner() -> None:
     warmup = int(os.environ.get("BENCH_WARMUP_ROUNDS", "3"))
     timed = int(os.environ.get("BENCH_TIMED_ROUNDS", "50"))
+    params = bench_params()
 
     import jax
     import jax.numpy as jnp
@@ -62,14 +173,15 @@ def run_child() -> None:
 
     log(
         f"child: backend={jax.default_backend()} devices={len(jax.devices())} "
-        f"K={K} B={B} agg={AGG} attack={ATTACK} warmup={warmup} timed={timed}"
+        f"K={params['k']} B={params['b']} agg={params['agg']} "
+        f"attack={params['attack']} warmup={warmup} timed={timed}"
     )
 
     cfg = FedConfig(
-        honest_size=K - B,
-        byz_size=B,
-        attack=ATTACK,
-        agg=AGG,
+        honest_size=params["k"] - params["b"],
+        byz_size=params["b"],
+        attack=params["attack"],
+        agg=params["agg"],
         rounds=warmup + 3 * timed,
         display_interval=10,
         batch_size=50,
@@ -106,24 +218,19 @@ def run_child() -> None:
     log(f"child: {timed} rounds in {dt:.3f}s -> {rps:.2f} rounds/sec "
         f"(val_loss={loss:.4f} val_acc={acc:.4f})")
 
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": round(rps, 3),
-                "unit": "rounds/sec",
-                "vs_baseline": round(rps / TARGET_ROUNDS_PER_SEC, 4),
-                "platform": jax.default_backend(),
-                "timed_rounds": timed,
-                "val_acc": round(float(acc), 4),
-            }
-        ),
-        flush=True,
+    emit_row(
+        make_bench_row(
+            rps,
+            platform=jax.default_backend(),
+            timed_rounds=timed,
+            val_acc=acc,
+            params=params,
+        )
     )
 
 
 # --------------------------------------------------------------------------
-# parent: probe + dispatch (no jax import, cannot hang on backend init)
+# parent: probe + dispatch (never initializes a backend, cannot hang)
 # --------------------------------------------------------------------------
 
 def _probe_backend(timeout: float | None):
@@ -148,6 +255,9 @@ def _run_bench_child(env: dict, timeout: float | None, timed_rounds: int):
     env = dict(env)
     env["BENCH_CHILD"] = "1"
     env["BENCH_TIMED_ROUNDS"] = str(timed_rounds)
+    # the parent owns the ledger append: a child-side append would double-
+    # record when the parent annotates and re-emits the row
+    env.pop("BENCH_LEDGER", None)
     try:
         proc = subprocess.run(
             [sys.executable, "-u", os.path.abspath(__file__)],
@@ -194,22 +304,26 @@ def main() -> None:
     log(f"probing device backend (timeout {probe_desc})")
     info = _probe_backend(probe_secs)
 
-    error = None
+    fallback_reason = None
+    relay = None
     result = None
     if info is not None and info["backend"] != "cpu":
         result = _run_bench_child(os.environ, run_secs, timed_rounds=timed)
         if result is None:
-            error = f"accelerator bench failed on backend={info['backend']}; cpu fallback"
+            fallback_reason = (
+                f"accelerator bench failed on backend={info['backend']}; "
+                "cpu fallback"
+            )
     elif info is None:
         from byzantine_aircomp_tpu.utils.env import diagnose_relay
 
         relay = diagnose_relay()
-        error = (
+        fallback_reason = (
             f"tunnel failure (relay {relay}): backend init did not complete "
             f"in {probe_desc}; cpu fallback"
         )
     else:
-        error = "no accelerator visible (cpu-only env); cpu fallback"
+        fallback_reason = "no accelerator visible (cpu-only env); cpu fallback"
 
     if result is None:
         from byzantine_aircomp_tpu.utils.env import scrubbed_cpu_env
@@ -218,20 +332,26 @@ def main() -> None:
         result = _run_bench_child(scrubbed_cpu_env(), cpu_secs, timed_rounds=cpu_timed)
 
     if result is None:
-        result = {
-            "metric": METRIC,
-            "value": 0.0,
-            "unit": "rounds/sec",
-            "vs_baseline": 0.0,
-            "platform": "none",
-            "error": (error or "bench failed") + "; cpu fallback also failed",
-        }
-        print(json.dumps(result), flush=True)
+        emit_row(
+            make_bench_row(
+                0.0,
+                platform="none",
+                timed_rounds=0,
+                fallback_reason=(fallback_reason or "bench failed")
+                + "; cpu fallback also failed",
+                relay=relay,
+            )
+        )
         sys.exit(1)
 
-    if error is not None:
-        result["error"] = error
-    print(json.dumps(result), flush=True)
+    # annotate the child's row with the parent's provenance and re-emit as
+    # the final stdout line (the driver parses the LAST JSON line)
+    if fallback_reason is not None:
+        result["fallback_reason"] = fallback_reason
+        result["error"] = fallback_reason  # historical field name
+    if relay is not None:
+        result["relay"] = relay
+    emit_row(result)
 
 
 if __name__ == "__main__":

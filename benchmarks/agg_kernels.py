@@ -98,6 +98,11 @@ def main(argv=None) -> int:
              "reduced shape and reported with mean_ms=null)",
     )
     ap.add_argument("--out", default=None, help="also write JSONL here")
+    ap.add_argument(
+        "--ledger", default=None,
+        help="append the timed epilogue rows to this perf ledger "
+             "(obs/ledger.py; gate with analysis/perf_gate.py)",
+    )
     args = ap.parse_args(argv)
 
     from byzantine_aircomp_tpu.ops import aggregators as agg_lib
@@ -196,6 +201,17 @@ def main(argv=None) -> int:
                     "best_ms": None if best_ms is None else round(best_ms, 3),
                     "unit": "ms", "platform": backend,
                 })
+                if args.ledger and mean_ms is not None:
+                    # ms rows gate with higher_is_better=False downstream;
+                    # the key carries agg/k so shapes never cross-compare
+                    obs_lib.PerfLedger(args.ledger).append(
+                        f"agg_epilogue_ms_{agg}_{impl}"
+                        f"{'_chan' if oma else ''}",
+                        round(mean_ms, 3),
+                        unit="ms", platform=backend,
+                        key=obs_lib.config_key({"k": k, "agg": agg, "b": b}),
+                        note="benchmarks/agg_kernels.py",
+                    )
 
     # acceptance summary: the platform's fused realization vs the sort path
     fused_impl = "pallas" if on_tpu else "select"

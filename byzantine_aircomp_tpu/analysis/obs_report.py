@@ -1,0 +1,280 @@
+"""Run report: render an observability event stream into one summary.
+
+Any ``*.events.jsonl`` produced with ``--obs-dir`` (training harness,
+sweep, fault matrix, benchmarks) renders into a single markdown (or
+``--json``) digest of what the run did and what it cost:
+
+    python -m byzantine_aircomp_tpu.analysis.obs_report runs/x.events.jsonl
+
+Sections (each present only when the stream carries the events):
+
+* **run** — title/backend/rounds from ``run_start``, wall-clock and
+  final metrics from ``run_end``;
+* **phases** — span breakdown by name (count, total/mean ms), with the
+  ``round`` spans split compile vs steady state (the ``compiled`` flag
+  set by the trainer — no warmup-pass guessing);
+* **retrace audit** — lowering counts per jitted fn and the
+  steady-state verdict;
+* **memory** — watermark trajectory from the ``round`` events'
+  ``bytes_in_use`` / ``peak_bytes_in_use`` plus the ``run_end`` summary
+  against the analytic model;
+* **defense** — escalations and final rung (``defense`` events;
+  ``analysis/defense_trace.py`` is the per-round deep dive);
+* **faults** — dropped/erased/corrupt totals and minimum effective K;
+* **bench/perf** — any ``bench`` or ``perf`` rows in the stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from .defense_trace import load_events
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} TiB"  # pragma: no cover - loop always returns
+
+
+def summarize(events: List[dict]) -> Dict[str, Any]:
+    """The machine-readable digest the markdown renders."""
+    out: Dict[str, Any] = {}
+
+    starts = [e for e in events if e.get("kind") == "run_start"]
+    ends = [e for e in events if e.get("kind") == "run_end"]
+    if starts:
+        s = starts[-1]
+        out["run"] = {
+            k: s.get(k)
+            for k in ("title", "backend", "rounds", "start_round", "k",
+                      "byz", "dim", "agg", "attack", "fault", "defense")
+        }
+    if ends:
+        e = ends[-1]
+        out["run_end"] = {
+            k: e.get(k)
+            for k in ("elapsed_secs", "rounds_run", "rounds_per_sec",
+                      "final_val_acc", "final_val_loss")
+        }
+
+    # phase breakdown; round spans split by the compiled flag
+    phases: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("kind") != "span" or "ms" not in e:
+            continue
+        name = str(e.get("name"))
+        if name == "round":
+            name = "round[compile]" if e.get("compiled") else "round[steady]"
+        p = phases.setdefault(name, {"count": 0, "total_ms": 0.0})
+        p["count"] += 1
+        p["total_ms"] += float(e["ms"])
+    for p in phases.values():
+        p["total_ms"] = round(p["total_ms"], 3)
+        p["mean_ms"] = round(p["total_ms"] / p["count"], 3)
+    if phases:
+        out["phases"] = phases
+        comp = phases.get("round[compile]", {}).get("total_ms", 0.0)
+        steady = phases.get("round[steady]", {}).get("total_ms", 0.0)
+        out["compile_vs_steady"] = {
+            "compile_ms": comp,
+            "steady_ms": steady,
+            "compile_fraction": round(comp / (comp + steady), 4)
+            if comp + steady else None,
+        }
+
+    retraces = [e for e in events if e.get("kind") == "retrace"]
+    if retraces:
+        r = retraces[-1]
+        out["retrace"] = {
+            "counts": r.get("counts"),
+            "steady_state_ok": r.get("steady_state_ok"),
+        }
+
+    rounds = [e for e in events if e.get("kind") == "round"]
+    peaks = [e["peak_bytes_in_use"] for e in rounds
+             if e.get("peak_bytes_in_use") is not None]
+    if peaks or (ends and ends[-1].get("memory")):
+        mem: Dict[str, Any] = {}
+        if peaks:
+            mem["rounds_with_watermarks"] = len(peaks)
+            mem["max_peak_bytes_in_use"] = max(peaks)
+            mem["source"] = next(
+                (e.get("mem_source") for e in rounds if e.get("mem_source")),
+                None,
+            )
+        if ends and isinstance(ends[-1].get("memory"), dict):
+            mem["run_end"] = ends[-1]["memory"]
+        out["memory"] = mem
+
+    defenses = [e for e in events if e.get("kind") == "defense"]
+    if defenses:
+        transitions = [e for e in defenses if e.get("transition")]
+        out["defense"] = {
+            "mode": defenses[-1].get("mode"),
+            "rounds": len(defenses),
+            "escalations": sum(
+                1 for e in transitions if e["transition"] == "escalate"
+            ),
+            "deescalations": sum(
+                1 for e in transitions if e["transition"] == "deescalate"
+            ),
+            "final_rung": defenses[-1].get("rung"),
+            "final_agg": defenses[-1].get("agg"),
+        }
+
+    faulted = [e for e in rounds if e.get("effective_k") is not None]
+    if faulted:
+        out["faults"] = {
+            "dropped": sum(e.get("dropped", 0) for e in faulted),
+            "erased": sum(e.get("erased", 0) for e in faulted),
+            "corrupt": sum(e.get("corrupt", 0) for e in faulted),
+            "min_effective_k": min(e["effective_k"] for e in faulted),
+        }
+
+    perf_rows = [
+        e for e in events if e.get("kind") in ("bench", "perf")
+        and e.get("metric") is not None
+    ]
+    if perf_rows:
+        out["perf_rows"] = [
+            {k: e.get(k) for k in ("kind", "metric", "value", "unit",
+                                   "platform", "fallback_reason")}
+            for e in perf_rows
+        ]
+
+    profiles = [e for e in events if e.get("kind") == "profile"]
+    if profiles:
+        out["profile"] = {
+            "dir": profiles[-1].get("dir"),
+            "rounds": profiles[-1].get("rounds"),
+        }
+    return out
+
+
+def markdown_report(summary: Dict[str, Any]) -> str:
+    out: List[str] = ["# run report", ""]
+    run = summary.get("run")
+    if run:
+        out.append(
+            f"**{run.get('title')}** — backend `{run.get('backend')}`, "
+            f"K={run.get('k')} (byz {run.get('byz')}), d={run.get('dim')}, "
+            f"agg `{run.get('agg')}`, attack `{run.get('attack')}`, "
+            f"fault `{run.get('fault')}`, defense `{run.get('defense')}`"
+        )
+    end = summary.get("run_end")
+    if end:
+        rps = end.get("rounds_per_sec")
+        out.append(
+            f"{end.get('rounds_run')} rounds in "
+            f"{end.get('elapsed_secs')}s"
+            + (f" ({rps} rounds/sec)" if rps is not None else "")
+            + (f", final val acc {end.get('final_val_acc'):.4f}"
+               if end.get("final_val_acc") is not None else "")
+        )
+    out.append("")
+
+    phases = summary.get("phases")
+    if phases:
+        out += ["## phases", "",
+                "| phase | count | total ms | mean ms |", "|---|---|---|---|"]
+        for name in sorted(phases):
+            p = phases[name]
+            out.append(
+                f"| {name} | {p['count']} | {p['total_ms']} | {p['mean_ms']} |"
+            )
+        cvs = summary.get("compile_vs_steady")
+        if cvs and cvs.get("compile_fraction") is not None:
+            out += ["", f"compile {cvs['compile_ms']} ms vs steady "
+                    f"{cvs['steady_ms']} ms — "
+                    f"{cvs['compile_fraction']:.1%} of round time compiling"]
+        out.append("")
+
+    rt = summary.get("retrace")
+    if rt:
+        ok = "OK" if rt.get("steady_state_ok") else "**FAILED**"
+        out += ["## retrace audit", "",
+                f"steady state {ok}; lowerings: {json.dumps(rt.get('counts'))}",
+                ""]
+
+    mem = summary.get("memory")
+    if mem:
+        out += ["## memory watermarks", ""]
+        if "max_peak_bytes_in_use" in mem:
+            out.append(
+                f"peak over {mem['rounds_with_watermarks']} rounds: "
+                f"{_fmt_bytes(mem['max_peak_bytes_in_use'])} "
+                f"(source `{mem.get('source')}`)"
+            )
+        re_mem = mem.get("run_end")
+        if isinstance(re_mem, dict):
+            flag = (" — **exceeds model**"
+                    if re_mem.get("exceeds_model") else "")
+            out.append(
+                f"run end: {_fmt_bytes(re_mem.get('peak_bytes_in_use'))} peak"
+                f" vs modeled {_fmt_bytes(re_mem.get('modeled_peak_bytes'))}"
+                f" (warn factor {re_mem.get('warn_factor')}){flag}"
+            )
+        out.append("")
+
+    d = summary.get("defense")
+    if d:
+        out += ["## defense", "",
+                f"mode `{d.get('mode')}`: {d.get('escalations')} escalation(s),"
+                f" {d.get('deescalations')} de-escalation(s); final rung "
+                f"{d.get('final_rung')} (`{d.get('final_agg')}`)", ""]
+
+    f = summary.get("faults")
+    if f:
+        out += ["## faults", "",
+                f"dropped {f['dropped']:.0f}, erased {f['erased']:.0f}, "
+                f"corrupt {f['corrupt']:.0f}; min effective K "
+                f"{f['min_effective_k']:.0f}", ""]
+
+    rows = summary.get("perf_rows")
+    if rows:
+        out += ["## bench/perf rows", "",
+                "| kind | metric | value | unit | platform | fallback |",
+                "|---|---|---|---|---|---|"]
+        for r in rows:
+            out.append(
+                f"| {r.get('kind')} | {r.get('metric')} | {r.get('value')} | "
+                f"{r.get('unit') or '-'} | {r.get('platform') or '-'} | "
+                f"{r.get('fallback_reason') or '-'} |"
+            )
+        out.append("")
+
+    prof = summary.get("profile")
+    if prof:
+        out += [f"device trace captured in `{prof.get('dir')}` "
+                f"(rounds {prof.get('rounds')})", ""]
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("events", help="events JSONL path (from --obs-dir)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable summary instead")
+    args = ap.parse_args(argv)
+    events = load_events(args.events)
+    if not events:
+        print("[obs_report] no events found", file=sys.stderr)
+        return 1
+    summary = summarize(events)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(markdown_report(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

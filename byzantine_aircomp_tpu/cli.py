@@ -163,6 +163,8 @@ ARG_TO_FIELD = {
     "defense_down": ("defense_down", None),
     "defense_min_flagged": ("defense_min_flagged", None),
     "profile_dir": ("profile_dir", None),
+    "profile_rounds": ("profile_rounds", None),
+    "hbm_warn_factor": ("hbm_warn_factor", None),
     "obs_dir": ("obs_dir", None),
     "obs_stdout": ("obs_stdout", None),
     "log_file": ("log_file", None),
@@ -304,7 +306,23 @@ def build_parser() -> argparse.ArgumentParser:
         "--profile-dir",
         type=str,
         default="",
-        help="write a jax.profiler trace of the run here",
+        help="write a jax.profiler trace of the run here (Perfetto/XProf; "
+        "rounds carry StepTraceAnnotation, eval/checkpoint named phases)",
+    )
+    p.add_argument(
+        "--profile-rounds",
+        type=str,
+        default="",
+        metavar="A:B",
+        help="restrict the trace to the half-open round window [A, B) "
+        "(requires --profile-dir)",
+    )
+    p.add_argument(
+        "--hbm-warn-factor",
+        type=float,
+        default=2.0,
+        help="warn when the measured device memory peak exceeds the "
+        "analytic model by this factor (output-only)",
     )
     # observability (docs/OBSERVABILITY.md) — output-only knobs: never part
     # of the run title or config hash, no effect on the trained program

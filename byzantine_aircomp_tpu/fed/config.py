@@ -250,10 +250,15 @@ class FedConfig:
     # misc
     mark: str = ""
     cache_dir: str = ""
-    # when set, the harness wraps the run in jax.profiler.trace(profile_dir);
-    # the round step carries named_scope phase annotations
+    # when set, the harness drives jax.profiler through obs/profile.py:
+    # the trace lands in profile_dir (loadable in Perfetto/XProf) with a
+    # StepTraceAnnotation per round and named eval/checkpoint phases on
+    # top of the round step's named_scope annotations
     # (client_local_step / message_attack / channel / aggregate)
     profile_dir: str = ""
+    # capture window "A:B" (half-open, round indices): trace only rounds
+    # [A, B) instead of the whole run; requires profile_dir
+    profile_rounds: str = ""
 
     # observability (obs/): structured telemetry knobs.  All output-only —
     # they relocate/duplicate what the run reports without touching the
@@ -270,6 +275,10 @@ class FedConfig:
     log_file: str = ""
     # silence the harness's stdout logging (the log_file tee still writes)
     quiet: bool = False
+    # warn when the measured device peak_bytes_in_use watermark exceeds
+    # the analytic model (obs/hbm.modeled_peak_bytes) by this factor;
+    # output-only like the other obs knobs
+    hbm_warn_factor: float = 2.0
 
     @property
     def node_size(self) -> int:
@@ -431,6 +440,18 @@ class FedConfig:
                 f"{self.honest_size} honest clients (corruption models "
                 f"crashed honest senders; Byzantine rows are the attack's)"
             )
+        if self.profile_rounds:
+            assert self.profile_dir, (
+                "profile_rounds requires profile_dir (a capture window "
+                "without a trace destination would silently do nothing)"
+            )
+            # fail on a malformed window at startup, not at round A
+            from ..obs.profile import parse_rounds
+
+            parse_rounds(self.profile_rounds)
+        assert self.hbm_warn_factor > 0, (
+            f"hbm_warn_factor must be positive, got {self.hbm_warn_factor}"
+        )
         assert self.defense in ("off", "monitor", "adaptive"), (
             f"defense must be off|monitor|adaptive, got {self.defense!r}"
         )

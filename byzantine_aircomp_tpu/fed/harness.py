@@ -10,7 +10,6 @@ same record keys including the ``variencePath`` spelling (``:481-489``).
 
 from __future__ import annotations
 
-import contextlib
 import dataclasses
 import os
 import sys
@@ -21,6 +20,7 @@ import jax.numpy as jnp
 
 from .. import obs as obs_lib
 from ..data import datasets as data_lib
+from ..utils import env as env_lib
 from ..utils import io as io_lib
 from . import checkpoint
 from .config import FedConfig
@@ -188,6 +188,7 @@ def config_hash(cfg: FedConfig) -> str:
         # the trajectory — hashing them would split checkpoint identity
         # between an observed and an unobserved run of the same config
         "obs_dir", "obs_stdout", "log_file", "quiet",
+        "profile_rounds", "hbm_warn_factor",
     )
     if cfg.defense == "off":
         # a defense-off config must hash identically to builds that
@@ -294,16 +295,22 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
     cfg.validate()
 
     restore_log = configure_log(cfg.log_file, cfg.quiet)
+    # fd-level stderr filter: XLA's per-compile machine-feature wall of
+    # text (ending in a SIGILL warning) collapses to one summary line;
+    # the full text survives only under --log-file
+    restore_stderr = env_lib.condense_stderr_warnings(cfg.log_file)
     obs = obs_lib.from_config(cfg, ckpt_title(cfg))
     try:
         return _run_inner(cfg, record_in_file, obs)
     finally:
         obs.close()
+        restore_stderr()
         restore_log()
 
 
 def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
     from ..obs import hbm as hbm_lib
+    from ..obs import profile as profile_lib
     from ..registry import OPTIMIZERS
 
     trainer_cls = OPTIMIZERS.get(cfg.opt)
@@ -417,15 +424,23 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
     )
     log("Optimization begin")
     t0 = time.perf_counter()
-    if cfg.profile_dir:
-        profile_ctx = jax.profiler.trace(cfg.profile_dir)
-        log(f"Profiling to {cfg.profile_dir}")
-    else:
-        profile_ctx = contextlib.nullcontext()
-    with profile_ctx:
+    profiler = profile_lib.from_config(cfg)
+    if profiler.enabled:
+        window = f" (rounds {cfg.profile_rounds})" if cfg.profile_rounds else ""
+        log(f"Profiling to {cfg.profile_dir}{window}")
+    profiler.start()  # whole-run mode; window mode opens at round A
+    try:
         paths = trainer.train(
             log_fn=log, checkpoint_fn=checkpoint_fn, start_round=start_round,
-            obs=obs,
+            obs=obs, profiler=profiler,
+        )
+    finally:
+        profiler.close()
+    if profiler.captured:
+        obs.emit(
+            "profile",
+            dir=cfg.profile_dir,
+            rounds=cfg.profile_rounds or "all",
         )
     elapsed = time.perf_counter() - t0
     # rounds/sec only when it means something: a 0-round schedule or a
@@ -445,6 +460,38 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
     if retrace is not None:
         steady_ok = retrace.check("round_fn", max_lowerings=1, warn_fn=log)
         obs.emit("retrace", counts=retrace.snapshot(), steady_state_ok=steady_ok)
+    # memory summary: measured watermark vs the analytic peak model.  Only
+    # device-sourced watermarks are cross-checked — a host RSS includes the
+    # interpreter/compiler and would trip the model on every CPU run.
+    memory = None
+    if obs.enabled:
+        memory = dict(profile_lib.device_memory())
+        ds = trainer.dataset
+        data_bytes = sum(
+            getattr(a, "nbytes", 0)
+            for a in (
+                getattr(ds, "x_train", None), getattr(ds, "y_train", None),
+                getattr(ds, "x_val", None), getattr(ds, "y_val", None),
+            )
+        )
+        modeled = hbm_lib.modeled_peak_bytes(
+            cfg.node_size, trainer.dim, data_bytes=data_bytes
+        )
+        memory["modeled_peak_bytes"] = modeled
+        memory["warn_factor"] = cfg.hbm_warn_factor
+        exceeds = (
+            str(memory.get("source", "")).startswith("device")
+            and memory["peak_bytes_in_use"] > cfg.hbm_warn_factor * modeled
+        )
+        memory["exceeds_model"] = bool(exceeds)
+        if exceeds:
+            log(
+                "WARNING: measured device peak "
+                f"{memory['peak_bytes_in_use']} bytes exceeds "
+                f"{cfg.hbm_warn_factor:g}x the modeled peak {modeled} bytes "
+                "(obs/hbm.modeled_peak_bytes) — an allocation the model "
+                "does not account for is resident"
+            )
     obs.emit(
         "run_end",
         elapsed_secs=round(elapsed, 3),
@@ -452,6 +499,7 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
         rounds_per_sec=None if rps is None else round(rps, 4),
         final_val_acc=paths["valAccPath"][-1],
         final_val_loss=paths["valLossPath"][-1],
+        memory=memory,
     )
 
     record = {

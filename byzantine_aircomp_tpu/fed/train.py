@@ -943,6 +943,7 @@ class FedTrainer:
         checkpoint_fn: Optional[Callable[[int, "FedTrainer"], None]] = None,
         start_round: int = 0,
         obs: Optional["obs_lib.Observability"] = None,
+        profiler: Optional["obs_lib.Profiler"] = None,
     ) -> Dict[str, List[float]]:
         """Full training run; returns reference-schema metric paths
         (``trainLossPath`` etc., pickled record keys at ``:481-489``).
@@ -955,10 +956,16 @@ class FedTrainer:
         the reference paths.  The observed program is the SAME program: no
         extra device syncs are introduced (the round span closes over the
         existing ``block_until_ready``) and eval/checkpoint spans only read
-        the host clock."""
+        the host clock.  ``profiler`` (default: the null profiler) names
+        each round as a ``StepTraceAnnotation`` and the eval/checkpoint
+        phases as ``TraceAnnotation`` regions in the device trace, and in
+        window mode (``--profile-rounds A:B``) owns the trace lifecycle
+        through the ``round_start``/``round_end`` hooks; while no trace is
+        active every hook is a no-op returning a shared null context."""
         cfg = self.cfg
         log = log_fn or (lambda s: None)
         obs = obs or obs_lib.NULL
+        profiler = profiler or obs_lib.NULL_PROFILER
 
         def eval_pair():
             if cfg.eval_train:
@@ -968,7 +975,8 @@ class FedTrainer:
             va = self.evaluate("val")
             return tr, va
 
-        with obs.span("eval", stage="initial", round=start_round):
+        with obs.span("eval", stage="initial", round=start_round), \
+                profiler.phase("eval"):
             (tr_loss, tr_acc), (va_loss, va_acc) = eval_pair()
         paths = {
             "trainLossPath": [tr_loss],
@@ -1002,9 +1010,10 @@ class FedTrainer:
         )
 
         for r in range(start_round, cfg.rounds):
+            profiler.round_start(r)  # window mode: open trace entering [A, B)
             lowerings_before = self.retrace.count("round_fn")
             t0 = time.perf_counter()
-            with obs.span("round", round=r) as sp:
+            with obs.span("round", round=r) as sp, profiler.step(r):
                 variance = self.run_round(r)
                 jax.block_until_ready(self.flat_params)
                 # True exactly when this call traced/compiled (round 0 of a
@@ -1014,7 +1023,8 @@ class FedTrainer:
                 compiled = self.retrace.count("round_fn") > lowerings_before
                 sp["compiled"] = compiled
             dt = time.perf_counter() - t0
-            with obs.span("eval", stage="round", round=r + 1):
+            with obs.span("eval", stage="round", round=r + 1), \
+                    profiler.phase("eval"):
                 (tr_loss, tr_acc), (va_loss, va_acc) = eval_pair()
             paths["trainLossPath"].append(tr_loss)
             paths["trainAccPath"].append(tr_acc)
@@ -1074,6 +1084,10 @@ class FedTrainer:
                 rounds_per_sec=1.0 / dt,
                 compiled=compiled,
                 fault_metrics=fault_metrics,
+                # per-round watermark (device allocator stats, or host RSS
+                # on backends without memory_stats) — host-side reads only,
+                # after the existing block_until_ready barrier
+                memory=obs_lib.device_memory() if obs.enabled else None,
             )
             log(
                 f"[{r + 1}/{cfg.rounds}](interval: {cfg.display_interval}) "
@@ -1081,8 +1095,10 @@ class FedTrainer:
                 f"val: loss={va_loss:.4f} acc={va_acc:.4f}{var_str}"
             )
             if checkpoint_fn is not None:
-                with obs.span("checkpoint", round=r + 1):
+                with obs.span("checkpoint", round=r + 1), \
+                        profiler.phase("checkpoint"):
                     checkpoint_fn(r + 1, self)
+            profiler.round_end(r)  # window mode: close trace leaving [A, B)
         return paths
 
     @property

@@ -10,6 +10,8 @@ lines everywhere:
 * :mod:`.span`    — phase timing (compile vs steady-state, eval, checkpoint)
 * :mod:`.retrace` — lowering counters that catch steady-state recompilation
 * :mod:`.hbm`     — static HBM-traffic models shared by benchmarks and trainer
+* :mod:`.profile` — jax.profiler device traces + memory watermarks
+* :mod:`.ledger`  — persisted perf ledger with noise-robust regression verdicts
 
 :class:`Observability` is the façade the harness/trainer thread through:
 ``obs.span(...)`` / ``obs.round(...)`` / ``obs.emit(...)``.  The disabled
@@ -30,6 +32,13 @@ from .events import (  # noqa: F401
     Collector,
     make_event,
     validate_event,
+)
+from .ledger import PerfLedger, config_key, robust_stats  # noqa: F401
+from .profile import (  # noqa: F401
+    NULL_PROFILER,
+    Profiler,
+    device_memory,
+    parse_rounds,
 )
 from .retrace import RetraceDetector, RetraceError  # noqa: F401
 from .sinks import (  # noqa: F401

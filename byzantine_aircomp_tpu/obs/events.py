@@ -60,6 +60,9 @@ _REQUIRED: Dict[str, tuple] = {
     "retrace": ("counts", "steady_state_ok"),
     "run_end": ("elapsed_secs", "rounds_run"),
     "defense": ("round", "rung", "flagged"),
+    # measurement layer (obs/profile.py, obs/ledger.py)
+    "profile": ("dir",),
+    "perf": ("metric", "value", "platform"),
 }
 
 
@@ -115,6 +118,7 @@ class Collector:
         rounds_per_sec: Optional[float] = None,
         compiled: Optional[bool] = None,
         fault_metrics: Optional[Dict[str, float]] = None,
+        memory: Optional[Dict[str, Any]] = None,
     ) -> None:
         fields: Dict[str, Any] = dict(
             round=round_idx,
@@ -132,4 +136,10 @@ class Collector:
             fields["compiled"] = compiled
         if fault_metrics:
             fields.update(fault_metrics)
+        if memory:
+            # watermark trio from obs.profile.device_memory — flat fields,
+            # with mem_source labeling device allocator stats vs host RSS
+            fields["bytes_in_use"] = memory.get("bytes_in_use")
+            fields["peak_bytes_in_use"] = memory.get("peak_bytes_in_use")
+            fields["mem_source"] = memory.get("source")
         self._sink.emit(make_event("round", **fields))

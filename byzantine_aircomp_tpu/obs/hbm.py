@@ -100,3 +100,30 @@ def aggregator_hbm_model(
         "hbm_x": None,
         "bytes_per_weiszfeld_iter": sb,
     }
+
+
+def modeled_peak_bytes(
+    k: int,
+    d: int,
+    *,
+    dtype_bytes: int = 4,
+    data_bytes: int = 0,
+    stack_copies: int = 3,
+    param_copies: int = 4,
+) -> int:
+    """Static peak-allocation model for the training program, the
+    cross-check target for measured ``peak_bytes_in_use`` watermarks
+    (``obs/profile.py``).
+
+    The resident [K, d] stack dominates; ``stack_copies`` covers the
+    worst transient (stack + perturbed/sorted copy + channel pair) and
+    ``param_copies`` the [d] vectors (params, update, optimizer-ish
+    temporaries).  ``data_bytes`` is the uploaded dataset.  Deliberately
+    conservative and shape-only — the measured side
+    (``benchmarks/hbm_compile.py``) answers the exact question; this
+    model exists so a watermark wildly above it (factor
+    ``hbm_warn_factor``) raises a flag on device-sourced measurements.
+    """
+    stack = stack_bytes(k, d, dtype_bytes)
+    params = d * dtype_bytes
+    return stack_copies * stack + param_copies * params + data_bytes

@@ -1,0 +1,223 @@
+"""Persisted performance ledger: turn bench snapshots into an enforced
+trajectory.
+
+The repo accumulates bench rows (``bench.py``, ``BENCH_r*.json``,
+``docs/bench_tpu_*.json``) but until now nothing *read* them — a 2x
+rounds/sec regression, or a silent CPU fallback posing as a TPU number
+(the ``BENCH_r05`` blind spot), would ship unnoticed.  The ledger is the
+machine that reads them:
+
+* one JSONL file (``docs/perf_ledger.jsonl`` by default, appended
+  through the existing :class:`obs.sinks.JsonlSink`) holding ``perf``
+  rows — ``{metric, value, unit, platform, key, note, ts}``;
+* baselines keyed on ``(metric, platform, key)`` where ``key`` encodes
+  the config-relevant knobs (:func:`config_key`) — rows measured under
+  different configs never average into one baseline;
+* noise-robust statistics: median + MAD over the last N same-platform
+  rows, so one outlier snapshot cannot move the baseline the way a mean
+  would;
+* a :func:`PerfLedger.compare` verdict: ``ok`` / ``regression`` /
+  ``improvement`` / ``new_metric`` / ``platform_mismatch``.  The
+  platform gate is absolute — a CPU-fallback row is NEVER compared
+  against a TPU baseline; it either matches CPU history or comes back
+  ``platform_mismatch``.
+
+``analysis/perf_gate.py`` is the CLI that wires a bench row + this
+ledger into a CI exit code.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from .events import make_event
+from .sinks import JsonlSink
+
+#: default on-disk location, relative to the repo root
+DEFAULT_LEDGER_PATH = "docs/perf_ledger.jsonl"
+
+#: row fields that define a comparable configuration (sorted into ``key``);
+#: deliberately excludes output-only knobs and per-run facts (timed_rounds,
+#: ts, value) — mirrors the config_hash philosophy at bench granularity
+CONFIG_KEY_FIELDS = ("k", "b", "agg", "attack", "dataset", "model")
+
+#: relative band half-width tolerated as noise (±10%)
+DEFAULT_REL_TOL = 0.10
+#: MAD multiples folded into the band (1.4826 * MAD ~ sigma for normals)
+DEFAULT_MAD_SIGMAS = 4.0
+#: baseline window: last N same-(metric, platform, key) rows
+DEFAULT_WINDOW = 10
+
+
+def config_key(row: Dict[str, Any]) -> str:
+    """Canonical config-knob key for a row: ``k=1000|b=100|agg=gm2|...``
+    over whichever :data:`CONFIG_KEY_FIELDS` the row carries (sorted).
+    Rows without any config fields (legacy ``BENCH_r*.json`` snapshots)
+    key to ``""`` — treated as a wildcard by :meth:`PerfLedger.compare`
+    so history predating the keying scheme stays comparable."""
+    parts = [
+        f"{f}={row[f]}"
+        for f in sorted(CONFIG_KEY_FIELDS)
+        if row.get(f) is not None
+    ]
+    return "|".join(parts)
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def robust_stats(values: List[float]) -> Dict[str, float]:
+    """Median + MAD (median absolute deviation) of ``values``."""
+    med = _median(values)
+    mad = _median([abs(v - med) for v in values])
+    return {"median": med, "mad": mad, "n": len(values)}
+
+
+class PerfLedger:
+    """Read/append/compare interface over one perf-ledger JSONL file."""
+
+    def __init__(self, path: str = DEFAULT_LEDGER_PATH) -> None:
+        self.path = path
+
+    def rows(self) -> List[Dict[str, Any]]:
+        """All parseable rows, in file order; malformed lines are skipped
+        with a stderr note (a killed append may truncate the tail)."""
+        out: List[Dict[str, Any]] = []
+        try:
+            fh = open(self.path)
+        except OSError:
+            return out
+        with fh:
+            for i, line in enumerate(fh):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    print(
+                        f"[ledger] skipping malformed line {i + 1} in "
+                        f"{self.path}",
+                        file=sys.stderr,
+                    )
+                    continue
+                if isinstance(row, dict) and "metric" in row:
+                    out.append(row)
+        return out
+
+    def append(
+        self,
+        metric: str,
+        value: float,
+        *,
+        unit: str = "",
+        platform: str = "",
+        key: str = "",
+        **extra: Any,
+    ) -> Dict[str, Any]:
+        """Append one ``perf`` row through a :class:`JsonlSink` (same
+        append-one-line-and-flush durability as every event stream)."""
+        event = make_event(
+            "perf",
+            metric=metric,
+            value=value,
+            unit=unit,
+            platform=platform,
+            key=key,
+            **extra,
+        )
+        sink = JsonlSink(self.path)
+        try:
+            sink.emit(event)
+        finally:
+            sink.close()
+        return event
+
+    def history(
+        self, metric: str, platform: str, key: str = ""
+    ) -> List[float]:
+        """Same-(metric, platform, key) values, file order (oldest first)."""
+        return [
+            float(r["value"])
+            for r in self._candidates(metric, key)
+            if r.get("platform") == platform and "value" in r
+        ]
+
+    def _candidates(self, metric: str, key: str) -> List[Dict[str, Any]]:
+        rows = [r for r in self.rows() if r.get("metric") == metric]
+        if not key:
+            return rows
+        # legacy rows with no key act as wildcards; a NON-empty key that
+        # differs means a genuinely different config under the same metric
+        # name — excluded from the baseline
+        return [r for r in rows if r.get("key", "") in ("", key)]
+
+    def compare(
+        self,
+        metric: str,
+        value: float,
+        *,
+        platform: str,
+        key: str = "",
+        window: int = DEFAULT_WINDOW,
+        rel_tol: float = DEFAULT_REL_TOL,
+        mad_sigmas: float = DEFAULT_MAD_SIGMAS,
+        higher_is_better: bool = True,
+    ) -> Dict[str, Any]:
+        """Verdict for a fresh measurement against the ledger.
+
+        The noise band is ``max(rel_tol, mad_sigmas * 1.4826 * MAD /
+        |median|)`` — at least ±``rel_tol`` relative (so a quiet
+        synthetic history still tolerates ±10% jitter), widened when the
+        recorded history is itself noisy.  ``ratio`` is value/median
+        oriented so that < 1 is worse regardless of
+        ``higher_is_better``.
+        """
+        verdict: Dict[str, Any] = {
+            "metric": metric,
+            "value": value,
+            "platform": platform,
+            "key": key,
+        }
+        candidates = self._candidates(metric, key)
+        if not candidates:
+            verdict["verdict"] = "new_metric"
+            return verdict
+        same_platform = [
+            r for r in candidates if r.get("platform") == platform
+        ]
+        if not same_platform:
+            # the BENCH_r05 blind spot: a cpu-fallback row must never be
+            # scored against an accelerator baseline
+            verdict["verdict"] = "platform_mismatch"
+            verdict["baseline_platforms"] = sorted(
+                {str(r.get("platform")) for r in candidates}
+            )
+            return verdict
+        hist = [
+            float(r["value"]) for r in same_platform if "value" in r
+        ][-window:]
+        stats = robust_stats(hist)
+        med, mad = stats["median"], stats["mad"]
+        verdict["baseline"] = {**stats, "window": window}
+        if med == 0:
+            verdict["verdict"] = "ok"  # degenerate baseline: nothing to scale
+            return verdict
+        raw_ratio = value / med
+        ratio = raw_ratio if higher_is_better else 1.0 / raw_ratio
+        band = max(rel_tol, mad_sigmas * 1.4826 * mad / abs(med))
+        verdict["ratio"] = ratio
+        verdict["band"] = band
+        if ratio < 1.0 - band:
+            verdict["verdict"] = "regression"
+        elif ratio > 1.0 + band:
+            verdict["verdict"] = "improvement"
+        else:
+            verdict["verdict"] = "ok"
+        return verdict

@@ -1,0 +1,220 @@
+"""Programmatic device profiling + memory watermarks.
+
+Two measurement surfaces the analytic side (``obs/hbm.py``,
+``obs/span.py``) cannot provide:
+
+* **Device traces** — :class:`Profiler` wraps ``jax.profiler`` so a run
+  started with ``--profile-dir DIR`` produces a trace directory loadable
+  in Perfetto/XProf, with every round a named ``StepTraceAnnotation``
+  (``round`` / ``step_num=r``) and eval/checkpoint phases named
+  ``TraceAnnotation`` regions.  ``--profile-rounds A:B`` restricts the
+  capture to the half-open round window ``[A, B)`` so a long run can
+  trace three steady-state rounds instead of gigabytes of everything.
+  With ``profile_dir`` unset every method is a no-op returning a shared
+  ``nullcontext`` — zero device syncs, zero allocations per round.
+
+* **Memory watermarks** — :func:`device_memory` reads
+  ``device.memory_stats()`` (``bytes_in_use`` / ``peak_bytes_in_use``)
+  from the first addressable device that reports them.  CPU backends
+  report none, so the fallback is the process RSS (current from
+  ``/proc/self/statm``, peak from ``ru_maxrss``) labeled
+  ``source: "host_rss"`` — watermark fields are always present on
+  ``round`` events of an observed run, and downstream consumers key on
+  ``source`` before comparing against the device-side HBM model.
+
+``jax`` is imported lazily inside methods: ``bench.py``'s parent process
+(and any other jax-free caller) can import :mod:`obs` without dragging
+in a backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Dict, Optional, Tuple
+
+#: reusable no-op context (``contextlib.nullcontext`` is re-entrant and
+#: stateless, so one shared instance serves every disabled annotation)
+_NULL_CTX = contextlib.nullcontext()
+
+
+def parse_rounds(spec: str) -> Tuple[int, int]:
+    """Parse a ``--profile-rounds A:B`` half-open window ``[A, B)``.
+
+    Raises ``ValueError`` on anything but ``int:int`` with
+    ``0 <= A < B`` — config validation calls this, so a bad spec dies at
+    startup, not at round A.
+    """
+    parts = spec.split(":")
+    if len(parts) != 2:
+        raise ValueError(
+            f"profile_rounds must be 'A:B' (half-open round window), got {spec!r}"
+        )
+    try:
+        a, b = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(f"profile_rounds bounds must be integers, got {spec!r}")
+    if a < 0 or b <= a:
+        raise ValueError(
+            f"profile_rounds needs 0 <= A < B, got {spec!r}"
+        )
+    return a, b
+
+
+class Profiler:
+    """jax.profiler driver for one run.
+
+    Whole-run mode (no window): the harness calls :meth:`start` before
+    the training loop and :meth:`close` after.  Window mode
+    (``profile_rounds='A:B'``): the trainer's :meth:`round_start` /
+    :meth:`round_end` hooks open the trace entering round A and close it
+    leaving round B-1.  Either way :meth:`step` wraps each round in a
+    ``StepTraceAnnotation`` and :meth:`phase` names eval/checkpoint
+    regions — both return the shared null context while no trace is
+    active, so annotations outside the window (or with profiling off)
+    cost one attribute check.
+    """
+
+    def __init__(self, profile_dir: str = "",
+                 window: Optional[Tuple[int, int]] = None) -> None:
+        self.profile_dir = profile_dir
+        self.window = window
+        self._active = False
+        #: True once any trace was captured (drives the ``profile`` event)
+        self.captured = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.profile_dir)
+
+    # -- trace lifecycle ------------------------------------------------
+    def _start_trace(self) -> None:
+        import jax
+
+        os.makedirs(self.profile_dir, exist_ok=True)
+        jax.profiler.start_trace(self.profile_dir)
+        self._active = True
+        self.captured = True
+
+    def _stop_trace(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+        self._active = False
+
+    def start(self) -> None:
+        """Whole-run capture: open the trace now (no-op in window mode —
+        the round hooks own the lifecycle there)."""
+        if self.enabled and self.window is None:
+            self._start_trace()
+
+    def round_start(self, round_idx: int) -> None:
+        """Window mode: open the trace when ``round_idx`` enters [A, B)."""
+        if (
+            self.enabled
+            and self.window is not None
+            and not self._active
+            and self.window[0] <= round_idx < self.window[1]
+        ):
+            self._start_trace()
+
+    def round_end(self, round_idx: int) -> None:
+        """Window mode: close the trace after the last window round."""
+        if (
+            self._active
+            and self.window is not None
+            and round_idx >= self.window[1] - 1
+        ):
+            self._stop_trace()
+
+    def close(self) -> None:
+        """Stop any open trace (harness ``finally`` — a run killed inside
+        the window still flushes what it captured)."""
+        if self._active:
+            self._stop_trace()
+
+    # -- annotations ----------------------------------------------------
+    def step(self, round_idx: int):
+        """Named per-round step region (``round`` in Perfetto/XProf)."""
+        if not self._active:
+            return _NULL_CTX
+        import jax
+
+        return jax.profiler.StepTraceAnnotation("round", step_num=round_idx)
+
+    def phase(self, name: str):
+        """Named phase region (``eval`` / ``checkpoint``)."""
+        if not self._active:
+            return _NULL_CTX
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+
+
+#: the disabled singleton — shared, every method a no-op
+NULL_PROFILER = Profiler()
+
+
+def from_config(cfg) -> Profiler:
+    """Build the run's Profiler from ``profile_dir`` / ``profile_rounds``
+    (:data:`NULL_PROFILER` when profiling is off)."""
+    profile_dir = getattr(cfg, "profile_dir", "")
+    if not profile_dir:
+        return NULL_PROFILER
+    spec = getattr(cfg, "profile_rounds", "")
+    return Profiler(profile_dir, parse_rounds(spec) if spec else None)
+
+
+# -- memory watermarks --------------------------------------------------
+
+def _host_rss() -> Tuple[int, int]:
+    """(current, peak) resident-set bytes of this process."""
+    page = os.sysconf("SC_PAGE_SIZE")
+    try:
+        with open("/proc/self/statm") as f:
+            current = int(f.read().split()[1]) * page
+    except (OSError, ValueError, IndexError):
+        current = 0
+    try:
+        import resource
+
+        # ru_maxrss is KiB on Linux
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        peak = current
+    return current, max(current, peak)
+
+
+def device_memory(devices=None) -> Dict[str, object]:
+    """Current + peak memory watermarks.
+
+    Returns ``{"bytes_in_use", "peak_bytes_in_use", "source"}`` where
+    ``source`` is ``"device:<platform>"`` when ``memory_stats()`` is
+    available (TPU/GPU allocator stats) or ``"host_rss"`` on backends
+    that report none (CPU).  Consumers MUST check ``source`` before
+    comparing against the analytic HBM model — a host RSS includes the
+    interpreter and compiler, not just program buffers.
+    """
+    if devices is None:
+        import jax
+
+        devices = jax.local_devices()
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats and "bytes_in_use" in stats:
+            return {
+                "bytes_in_use": int(stats["bytes_in_use"]),
+                "peak_bytes_in_use": int(
+                    stats.get("peak_bytes_in_use", stats["bytes_in_use"])
+                ),
+                "source": f"device:{dev.platform}",
+            }
+    current, peak = _host_rss()
+    return {
+        "bytes_in_use": current,
+        "peak_bytes_in_use": peak,
+        "source": "host_rss",
+    }

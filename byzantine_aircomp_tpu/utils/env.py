@@ -35,6 +35,102 @@ def scrubbed_cpu_env(n_devices: int | None = None) -> dict:
     return env
 
 
+#: substrings identifying the XLA:CPU feature-mismatch wall of text (one
+#: multi-KB line per compile enumerating every ISA flag, ending in a
+#: SIGILL warning — see the BENCH_r05.json / MULTICHIP_r05.json tails)
+_XLA_FEATURE_WARNING_MARKERS = (
+    "match the machine type for execution",
+    "could lead to execution errors such as SIGILL",
+)
+
+_XLA_WARNING_SUMMARY = (
+    "[env] XLA:CPU compile/host machine-feature mismatch warning suppressed "
+    "(cached executable may use unsupported ISA extensions -> SIGILL)"
+)
+
+
+def condense_stderr_warnings(log_file: str = ""):
+    """Collapse the XLA feature-mismatch wall of text to one stderr line.
+
+    The warning is emitted by native code writing straight to fd 2 (it is
+    not reachable through Python's ``warnings``/``logging``), so this
+    installs an fd-level filter: stderr is swapped for a pipe, a reader
+    thread forwards everything verbatim EXCEPT lines carrying the
+    :data:`_XLA_FEATURE_WARNING_MARKERS`, which are replaced (once) by a
+    one-line summary.  When ``log_file`` is set the full original text is
+    appended there, so ``--log-file`` keeps the complete record.
+
+    Returns a zero-arg ``restore()`` callable; callers wrap the run in
+    ``try/finally``.  Safe to call when stderr is not a real fd (pytest
+    capture replaces ``sys.stderr`` with an object — this filter only
+    touches fd 2, and ``restore()`` always puts the original back).
+    """
+    import threading
+
+    try:
+        saved_fd = os.dup(2)
+    except OSError:  # no usable stderr fd at all: nothing to filter
+        return lambda: None
+    read_fd, write_fd = os.pipe()
+    os.dup2(write_fd, 2)
+    os.close(write_fd)
+    summarized = [False]
+
+    def _matches(line: bytes) -> bool:
+        return any(m.encode() in line for m in _XLA_FEATURE_WARNING_MARKERS)
+
+    def _forward(chunk: bytes) -> None:
+        try:
+            os.write(saved_fd, chunk)
+        except OSError:
+            pass
+
+    def _handle(line: bytes) -> None:
+        if _matches(line):
+            if log_file:
+                try:
+                    with open(log_file, "ab") as f:
+                        f.write(line)
+                except OSError:
+                    pass
+            if not summarized[0]:
+                summarized[0] = True
+                _forward(_XLA_WARNING_SUMMARY.encode() + b"\n")
+        else:
+            _forward(line)
+
+    def _reader() -> None:
+        buf = b""
+        while True:
+            try:
+                chunk = os.read(read_fd, 65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                _handle(line + b"\n")
+        if buf:
+            _handle(buf)
+        os.close(read_fd)
+
+    thread = threading.Thread(
+        target=_reader, name="stderr-condenser", daemon=True
+    )
+    thread.start()
+
+    def restore() -> None:
+        # putting the original fd back closes this process's last write end
+        # of the pipe, so the reader sees EOF and drains whatever is left
+        os.dup2(saved_fd, 2)
+        thread.join(timeout=5.0)
+        os.close(saved_fd)
+
+    return restore
+
+
 def default_cache_dir() -> str:
     """Repo-local persistent XLA compilation cache dir (gitignored) — the
     single derivation shared by conftest and the subprocess env, so the

@@ -207,10 +207,13 @@ def test_config_hash_off_matches_predefense_formula():
 
     cfg = FedConfig(agg="mean", honest_size=6, byz_size=2, rounds=3)
     # recompute the hash exactly as pre-defense builds did: no defense
-    # fields existed, so they never entered the material
+    # fields existed, so they never entered the material (the same goes
+    # for output-only knobs added since — profile_rounds/hbm_warn_factor
+    # are excluded from the hash like every other obs knob)
     skip = (
         "checkpoint_dir", "cache_dir", "profile_dir", "inherit", "rounds",
         "obs_dir", "obs_stdout", "log_file", "quiet",
+        "profile_rounds", "hbm_warn_factor",
     )
     items = sorted(
         (f.name, repr(getattr(cfg, f.name)))

@@ -1,0 +1,449 @@
+"""Perf-trajectory machinery: ledger statistics, the regression gate,
+the run report, the stderr condenser, and bench.py's row contract.
+
+The acceptance bar (ISSUE): the gate exits nonzero on a synthetic 2x
+regression, zero on the repo's current committed bench row, and
+classifies a CPU-fallback row against a TPU baseline as
+``platform_mismatch`` — never a false regression (the ``BENCH_r05``
+blind spot).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from byzantine_aircomp_tpu import obs as obs_lib
+from byzantine_aircomp_tpu.analysis import obs_report, perf_gate
+from byzantine_aircomp_tpu.obs.ledger import PerfLedger, config_key, robust_stats
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- ledger
+
+
+def test_config_key_sorted_and_sparse():
+    row = {"k": 1000, "b": 100, "agg": "gm2", "attack": "classflip",
+           "dataset": "mnist", "model": "MLP", "value": 1.0, "ts": 5}
+    key = config_key(row)
+    assert key == ("agg=gm2|attack=classflip|b=100|dataset=mnist"
+                   "|k=1000|model=MLP")
+    # per-run facts (value/ts/timed_rounds) never leak into the key
+    assert "value" not in key and "ts" not in key
+    assert config_key({"k": 32, "agg": "mean"}) == "agg=mean|k=32"
+    # legacy rows with no config fields key to the wildcard
+    assert config_key({"metric": "x", "value": 1.0}) == ""
+
+
+def test_robust_stats_median_and_mad():
+    s = robust_stats([1.0, 2.0, 3.0, 4.0, 100.0])
+    assert s["median"] == 3.0 and s["mad"] == 1.0 and s["n"] == 5
+    # one outlier cannot move the median the way it would a mean
+    assert robust_stats([10.0, 10.0, 10.0, 1e9])["median"] == 10.0
+
+
+def test_ledger_append_rows_roundtrip(tmp_path):
+    led = PerfLedger(str(tmp_path / "led.jsonl"))
+    assert led.rows() == []  # absent file: empty, no error
+    ev = led.append("rps", 1.5, unit="rounds/sec", platform="cpu",
+                    key="k=8", note="test")
+    obs_lib.validate_event(ev)  # appended rows are schema-valid events
+    led.append("rps", 1.6, unit="rounds/sec", platform="cpu", key="k=8")
+    rows = led.rows()
+    assert [r["value"] for r in rows] == [1.5, 1.6]
+    assert rows[0]["kind"] == "perf" and rows[0]["platform"] == "cpu"
+    assert led.history("rps", "cpu", "k=8") == [1.5, 1.6]
+    assert led.history("rps", "tpu", "k=8") == []
+
+
+def test_ledger_skips_malformed_lines(tmp_path, capsys):
+    p = tmp_path / "led.jsonl"
+    good = json.dumps({"metric": "m", "value": 1.0, "platform": "cpu"})
+    p.write_text(good + "\n{torn-by-a-kill\n" + good + "\n")
+    rows = PerfLedger(str(p)).rows()
+    assert len(rows) == 2
+    assert "malformed line 2" in capsys.readouterr().err
+
+
+def _seeded(tmp_path, platform="tpu", key=""):
+    led = PerfLedger(str(tmp_path / "led.jsonl"))
+    for v in [100.0, 92.0, 107.0, 98.0, 103.0, 95.0, 109.0, 101.0]:
+        led.append("rps", v, unit="rounds/sec", platform=platform, key=key)
+    return led
+
+
+def test_compare_catches_2x_slowdown(tmp_path):
+    v = _seeded(tmp_path).compare("rps", 50.0, platform="tpu")
+    assert v["verdict"] == "regression"
+    assert v["ratio"] < 0.9 and v["baseline"]["n"] == 8
+
+
+def test_compare_tolerates_10pct_noise(tmp_path):
+    led = _seeded(tmp_path)
+    assert led.compare("rps", 108.5, platform="tpu")["verdict"] == "ok"
+    assert led.compare("rps", 91.5, platform="tpu")["verdict"] == "ok"
+
+
+def test_compare_flags_improvement(tmp_path):
+    v = _seeded(tmp_path).compare("rps", 200.0, platform="tpu")
+    assert v["verdict"] == "improvement"
+
+
+def test_compare_platform_mismatch_refuses_cross_platform(tmp_path):
+    # a CPU-fallback row must NEVER be scored against the TPU baseline
+    v = _seeded(tmp_path).compare("rps", 0.6, platform="cpu")
+    assert v["verdict"] == "platform_mismatch"
+    assert v["baseline_platforms"] == ["tpu"]
+    assert "ratio" not in v  # no comparison happened at all
+
+
+def test_compare_new_metric(tmp_path):
+    v = _seeded(tmp_path).compare("never_seen", 1.0, platform="tpu")
+    assert v["verdict"] == "new_metric"
+
+
+def test_compare_key_isolation_and_legacy_wildcard(tmp_path):
+    led = PerfLedger(str(tmp_path / "led.jsonl"))
+    led.append("rps", 100.0, platform="tpu", key="k=1000")
+    led.append("rps", 5.0, platform="tpu", key="k=32")
+    # a different non-empty key never averages into the baseline
+    v = led.compare("rps", 100.0, platform="tpu", key="k=1000")
+    assert v["verdict"] == "ok" and v["baseline"]["median"] == 100.0
+    # legacy rows (key "") act as wildcards for any incoming key
+    led.append("rps", 100.0, platform="tpu", key="")
+    v2 = led.compare("rps", 100.0, platform="tpu", key="k=1000")
+    assert v2["baseline"]["n"] == 2
+
+
+def test_compare_window_uses_last_n(tmp_path):
+    led = PerfLedger(str(tmp_path / "led.jsonl"))
+    for v in [10.0] * 5 + [100.0] * 5:
+        led.append("rps", v, platform="tpu")
+    # window 5 sees only the recent regime: 50 is a 2x regression there
+    v = led.compare("rps", 50.0, platform="tpu", window=5)
+    assert v["baseline"]["median"] == 100.0
+    assert v["verdict"] == "regression"
+
+
+def test_compare_lower_is_better_metrics(tmp_path):
+    led = PerfLedger(str(tmp_path / "led.jsonl"))
+    for v in [40.0, 41.0, 39.0, 40.5]:
+        led.append("ms", v, unit="ms", platform="tpu")
+    # latency doubling is a regression even though the value went UP
+    v = led.compare("ms", 80.0, platform="tpu", higher_is_better=False)
+    assert v["verdict"] == "regression"
+    v = led.compare("ms", 20.0, platform="tpu", higher_is_better=False)
+    assert v["verdict"] == "improvement"
+
+
+# ---------------------------------------------------------- perf_gate
+
+
+def test_extract_row_shapes():
+    bare = {"metric": "m", "value": 1.0}
+    assert perf_gate.extract_row(bare) is bare
+    # driver snapshot: the row hides under "parsed"
+    assert perf_gate.extract_row({"rc": 0, "parsed": bare}) is bare
+    # list: last parseable row wins
+    assert perf_gate.extract_row(
+        [{"x": 1}, bare, {"metric": "n", "value": 2.0}]
+    )["metric"] == "n"
+    assert perf_gate.extract_row({"no": "row"}) is None
+    assert perf_gate.extract_row("text") is None
+
+
+def test_load_row_json_and_jsonl(tmp_path):
+    p = tmp_path / "row.json"
+    p.write_text(json.dumps({"parsed": {"metric": "m", "value": 3.0}}))
+    assert perf_gate.load_row(str(p))["value"] == 3.0
+    q = tmp_path / "rows.jsonl"
+    q.write_text('not json\n{"metric":"a","value":1}\n'
+                 '{"metric":"b","value":2}\n')
+    assert perf_gate.load_row(str(q))["metric"] == "b"
+
+
+def test_gate_expect_platform_forces_mismatch(tmp_path):
+    led = _seeded(tmp_path)
+    row = {"metric": "rps", "value": 0.6, "platform": "cpu",
+           "fallback_reason": "relay wedged"}
+    v = perf_gate.gate(row, led, expect_platform="tpu")
+    assert v["verdict"] == "platform_mismatch"
+    assert v["expected_platform"] == "tpu"
+    assert v["fallback_reason"] == "relay wedged"
+
+
+def test_gate_self_check_passes(capsys):
+    assert perf_gate.self_check() == perf_gate.EXIT_OK
+    out = capsys.readouterr().out
+    assert out.count("PASS") == 5 and "FAIL" not in out
+
+
+def test_gate_main_exit_codes(tmp_path, capsys):
+    led_path = str(tmp_path / "led.jsonl")
+    _seeded(tmp_path)
+    base = ["--ledger", led_path]
+    # acceptance: synthetic 2x slowdown exits nonzero
+    assert perf_gate.main(
+        base + ["--metric", "rps", "--value", "50", "--platform", "tpu"]
+    ) == perf_gate.EXIT_REGRESSION
+    # in-band value exits zero
+    assert perf_gate.main(
+        base + ["--metric", "rps", "--value", "101", "--platform", "tpu"]
+    ) == perf_gate.EXIT_OK
+    # platform mismatch: loud but zero by default, 3 under strict
+    assert perf_gate.main(
+        base + ["--metric", "rps", "--value", "0.6", "--platform", "cpu"]
+    ) == perf_gate.EXIT_OK
+    assert perf_gate.main(
+        base + ["--metric", "rps", "--value", "0.6", "--platform", "cpu",
+                "--strict-platform"]
+    ) == perf_gate.EXIT_PLATFORM
+    # no row at all is a usage error
+    assert perf_gate.main(base) == perf_gate.EXIT_USAGE
+    capsys.readouterr()
+
+
+def test_gate_main_committed_bench_row_is_green(capsys):
+    # acceptance: the repo's own committed artifacts gate clean — the
+    # BENCH_r05 CPU row scores ok against the seeded CPU history
+    ledger = os.path.join(REPO, "docs", "perf_ledger.jsonl")
+    row = os.path.join(REPO, "BENCH_r05.json")
+    if not (os.path.exists(ledger) and os.path.exists(row)):
+        pytest.skip("committed bench artifacts not present")
+    assert perf_gate.main(["--ledger", ledger, "--row", row]) == 0
+    assert "[perf_gate] ok" in capsys.readouterr().out
+    # and the SAME row demanded on tpu is the classified fallback trap
+    assert perf_gate.main(
+        ["--ledger", ledger, "--row", row, "--expect-platform", "tpu",
+         "--strict-platform"]
+    ) == perf_gate.EXIT_PLATFORM
+    assert "platform_mismatch" in capsys.readouterr().out
+
+
+def test_gate_main_append_extends_baseline(tmp_path, capsys):
+    led_path = str(tmp_path / "led.jsonl")
+    led = _seeded(tmp_path)
+    n0 = len(led.rows())
+    args = ["--ledger", led_path, "--metric", "rps", "--platform", "tpu",
+            "--append"]
+    assert perf_gate.main(args + ["--value", "102"]) == 0
+    assert len(led.rows()) == n0 + 1  # green rows extend the baseline
+    assert perf_gate.main(args + ["--value", "50"]) == 1
+    assert len(led.rows()) == n0 + 1  # regressions NEVER pollute it
+    capsys.readouterr()
+
+
+def test_gate_main_json_output(tmp_path, capsys):
+    _seeded(tmp_path)
+    assert perf_gate.main(
+        ["--ledger", str(tmp_path / "led.jsonl"), "--metric", "rps",
+         "--value", "101", "--platform", "tpu", "--json"]
+    ) == 0
+    v = json.loads(capsys.readouterr().out)
+    assert v["verdict"] == "ok" and "baseline" in v
+
+
+# --------------------------------------------------------- obs_report
+
+
+def _synthetic_events():
+    ev = [
+        obs_lib.make_event("run_start", title="t", backend="cpu", rounds=3,
+                           start_round=0, k=6, byz=0, dim=100, agg="mean",
+                           attack="none", fault="none", defense="off"),
+        obs_lib.make_event("span", name="setup", ms=50.0),
+        obs_lib.make_event("span", name="round", ms=900.0, compiled=True),
+        obs_lib.make_event("span", name="round", ms=100.0, compiled=False),
+        obs_lib.make_event("span", name="round", ms=110.0, compiled=False),
+        obs_lib.make_event("span", name="eval", ms=20.0),
+    ]
+    for r in range(3):
+        ev.append(obs_lib.make_event(
+            "round", round=r, val_loss=1.0, val_acc=0.5, variance=0.1,
+            bytes_in_use=1000 + r, peak_bytes_in_use=2000 + r,
+            mem_source="host_rss",
+        ))
+    ev += [
+        obs_lib.make_event("retrace", counts={"round_fn": 1},
+                           steady_state_ok=True),
+        obs_lib.make_event("profile", dir="/tmp/trace", rounds="all"),
+        obs_lib.make_event("bench", metric="rps", value=1.5, unit="rounds/sec",
+                           platform="cpu", fallback_reason=None),
+        obs_lib.make_event("run_end", elapsed_secs=1.2, rounds_run=3,
+                           rounds_per_sec=2.5, final_val_acc=0.5,
+                           final_val_loss=1.0,
+                           memory={"bytes_in_use": 1002,
+                                   "peak_bytes_in_use": 2002,
+                                   "source": "host_rss",
+                                   "modeled_peak_bytes": 1200,
+                                   "warn_factor": 2.0,
+                                   "exceeds_model": False}),
+    ]
+    return ev
+
+
+def test_obs_report_summarize():
+    s = obs_report.summarize(_synthetic_events())
+    assert s["run"]["backend"] == "cpu"
+    assert s["phases"]["round[compile]"]["count"] == 1
+    assert s["phases"]["round[steady]"]["count"] == 2
+    # compile dominated: 900 vs 210 steady
+    assert s["compile_vs_steady"]["compile_fraction"] > 0.8
+    assert s["retrace"]["steady_state_ok"] is True
+    assert s["memory"]["rounds_with_watermarks"] == 3
+    assert s["memory"]["max_peak_bytes_in_use"] == 2002
+    assert s["memory"]["run_end"]["exceeds_model"] is False
+    assert s["perf_rows"][0]["metric"] == "rps"
+    assert s["profile"]["dir"] == "/tmp/trace"
+
+
+def test_obs_report_markdown_sections():
+    md = obs_report.markdown_report(obs_report.summarize(_synthetic_events()))
+    for heading in ("# run report", "## phases", "## retrace audit",
+                    "## memory watermarks", "## bench/perf rows"):
+        assert heading in md
+    assert "round[compile]" in md and "host_rss" in md
+    # absent sections render nothing rather than empty headers
+    assert "## defense" not in md and "## faults" not in md
+
+
+def test_obs_report_main(tmp_path, capsys):
+    p = tmp_path / "x.events.jsonl"
+    with open(p, "w") as f:
+        for e in _synthetic_events():
+            f.write(json.dumps(e) + "\n")
+    assert obs_report.main([str(p), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["run"]["title"] == "t"
+    assert obs_report.main([str(p)]) == 0
+    assert "# run report" in capsys.readouterr().out
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert obs_report.main([str(empty)]) == 1
+
+
+# --------------------------------------------------- stderr condenser
+
+
+def test_condense_stderr_warnings_subprocess(tmp_path):
+    """The XLA machine-feature wall of text collapses to ONE summary line
+    on stderr; the full text survives only in the log file.  Run in a
+    subprocess: the filter swaps fd 2, which must not fight pytest's own
+    capture."""
+    log = tmp_path / "full.log"
+    script = f"""
+import os, sys
+sys.path.insert(0, {REPO!r})
+from byzantine_aircomp_tpu.utils.env import condense_stderr_warnings
+restore = condense_stderr_warnings({str(log)!r})
+os.write(2, b"normal progress line\\n")
+wall = b"E0000 ... " + b"x" * 200 + b" does not match the machine type for execution ... could lead to execution errors such as SIGILL\\n"
+os.write(2, wall)
+os.write(2, wall)
+os.write(2, b"after the wall\\n")
+restore()
+os.write(2, b"post-restore line\\n")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    err = proc.stderr
+    # passthrough lines intact, before/after/post-restore
+    assert "normal progress line" in err
+    assert "after the wall" in err
+    assert "post-restore line" in err
+    # the wall collapsed to exactly one summary, full text gone from stderr
+    assert err.count("machine-feature mismatch warning suppressed") == 1
+    assert "xxxx" not in err
+    # --log-file keeps the complete record (both occurrences)
+    assert open(log).read().count("SIGILL") == 2
+
+
+def test_condense_stderr_no_log_file(tmp_path):
+    script = f"""
+import os, sys
+sys.path.insert(0, {REPO!r})
+from byzantine_aircomp_tpu.utils.env import condense_stderr_warnings
+restore = condense_stderr_warnings()
+os.write(2, b"warn: could lead to execution errors such as SIGILL\\n")
+restore()
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stderr.count("suppressed") == 1
+
+
+# ----------------------------------------------------- bench.py rows
+
+
+@pytest.fixture(scope="module")
+def bench_mod():
+    spec = importlib.util.spec_from_file_location(
+        "bench_script", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_params_default_and_tiny(bench_mod, monkeypatch):
+    monkeypatch.delenv("BENCH_TINY", raising=False)
+    p = bench_mod.bench_params()
+    assert (p["k"], p["b"]) == (1000, 100)
+    monkeypatch.setenv("BENCH_TINY", "1")
+    t = bench_mod.bench_params()
+    assert (t["k"], t["b"]) == (32, 4)
+    # tiny rows carry their OWN metric name: they can never average into
+    # the north-star baseline
+    assert t["metric"] != p["metric"]
+
+
+def test_make_bench_row_contract(bench_mod, monkeypatch):
+    monkeypatch.delenv("BENCH_TINY", raising=False)
+    row = bench_mod.make_bench_row(
+        60.0, platform="tpu", timed_rounds=50, val_acc=0.91,
+    )
+    obs_lib.validate_event(row)
+    assert row["kind"] == "bench" and row["platform"] == "tpu"
+    assert row["fallback_reason"] is None and "error" not in row
+    assert row["vs_baseline"] == round(60.0 / bench_mod.TARGET_ROUNDS_PER_SEC, 4)
+    # the ledger key is derived from the row's own config fields
+    assert config_key(row) == ("agg=gm2|attack=classflip|b=100"
+                               "|dataset=mnist|k=1000|model=MLP")
+    fb = bench_mod.make_bench_row(
+        0.6, platform="cpu", timed_rounds=10,
+        fallback_reason="probe timeout", relay="listening",
+    )
+    assert fb["fallback_reason"] == "probe timeout"
+    assert fb["error"] == "probe timeout"  # historical field, kept
+    assert fb["relay"] == "listening"
+
+
+def test_bench_emit_row_ledger_append(bench_mod, tmp_path, capsys,
+                                      monkeypatch):
+    led_path = str(tmp_path / "bench_led.jsonl")
+    monkeypatch.setenv("BENCH_LEDGER", led_path)
+    monkeypatch.delenv("BENCH_TINY", raising=False)
+    row = bench_mod.make_bench_row(0.7, platform="cpu", timed_rounds=10,
+                                   fallback_reason="probe timeout")
+    bench_mod.emit_row(row)
+    out = capsys.readouterr().out
+    assert json.loads(out.strip())["metric"] == row["metric"]
+    rows = PerfLedger(led_path).rows()
+    assert len(rows) == 1
+    assert rows[0]["platform"] == "cpu"
+    assert rows[0]["key"] == config_key(row)
+    assert "(fallback)" in rows[0]["note"]
+    # total failure rows (platform "none") are never ledger material
+    bench_mod.emit_row(bench_mod.make_bench_row(
+        0.0, platform="none", timed_rounds=0, fallback_reason="all failed"))
+    assert len(PerfLedger(led_path).rows()) == 1
+    capsys.readouterr()
