@@ -33,6 +33,19 @@ rc=3 with no diagnostics):
            ``fallback_reason`` so the artifact is self-describing.
 
 Either way the driver gets one parseable JSON line, never a silent hang.
+
+A wedged probe is retried (``BENCH_PROBE_RETRIES``, default 2 extra
+attempts with ``BENCH_PROBE_BACKOFF_SECS`` between them — transient relay
+restarts recover within seconds) and every failed attempt's relay
+diagnosis is recorded in ``fallback_reason`` so the artifact says WHICH
+tunnel state was observed, per attempt, before the CPU fallback.
+
+``BENCH_STREAM_KSWEEP=1`` switches to a different mode entirely: a small
+cohort-streamed K-sweep (``fed/train.py`` ``--cohort-size`` path) that
+emits one ``stream_ksweep`` row per K with rounds/sec AND the peak-bytes
+columns (measured watermark + the ``obs/hbm.py`` streamed/resident
+models), one JSON line per K on stdout.  Rows land in the ledger via
+``BENCH_LEDGER`` or ``analysis/perf_gate.py --append``.
 """
 
 from __future__ import annotations
@@ -126,8 +139,13 @@ def emit_row(row: dict) -> None:
     StdoutSink().emit(row)
     ledger_path = os.environ.get("BENCH_LEDGER")
     if ledger_path and row.get("platform") not in (None, "none"):
-        from byzantine_aircomp_tpu.obs.ledger import PerfLedger, config_key
+        from byzantine_aircomp_tpu.obs.ledger import (
+            LEDGER_EXTRA_FIELDS, PerfLedger, config_key,
+        )
 
+        extra = {
+            f: row[f] for f in LEDGER_EXTRA_FIELDS if row.get(f) is not None
+        }
         PerfLedger(ledger_path).append(
             str(row["metric"]), float(row["value"]),
             unit=str(row.get("unit", "")),
@@ -136,6 +154,7 @@ def emit_row(row: dict) -> None:
             timed_rounds=row.get("timed_rounds"),
             note="bench.py" + (" (fallback)" if row.get("fallback_reason")
                               else ""),
+            **extra,
         )
         log(f"appended row to ledger {ledger_path}")
 
@@ -230,6 +249,101 @@ def _run_child_inner() -> None:
 
 
 # --------------------------------------------------------------------------
+# stream_ksweep mode: streamed-round scaling rows (BENCH_STREAM_KSWEEP=1)
+# --------------------------------------------------------------------------
+
+def run_stream_ksweep() -> None:
+    """Cohort-streamed K-sweep: one ``stream_ksweep`` row per K.
+
+    Answers the question the north-star bench cannot: how do rounds/sec
+    and peak memory scale with K when the round never materializes the
+    resident [K, d] stack (``fed/train.py`` ``--cohort-size`` streaming)?
+    Each row carries the measured watermark (``obs/profile.device_memory``
+    — source-labeled, host RSS on CPU) plus BOTH analytic peak models
+    (``obs/hbm.streamed_peak_bytes`` and the resident
+    ``modeled_peak_bytes``), so the ledger records the gap streaming
+    opens.  Env knobs: ``BENCH_KSWEEP_KS`` (comma list),
+    ``BENCH_KSWEEP_COHORT``, ``BENCH_KSWEEP_AGG``, ``BENCH_KSWEEP_ROUNDS``
+    (timed rounds per K).  Runs on whatever backend the env selects — the
+    CI smoke pins JAX_PLATFORMS=cpu.
+    """
+    ks = [
+        int(s)
+        for s in os.environ.get("BENCH_KSWEEP_KS", "64,256,1024").split(",")
+        if s.strip()
+    ]
+    cohort = int(os.environ.get("BENCH_KSWEEP_COHORT", "32"))
+    agg = os.environ.get("BENCH_KSWEEP_AGG", "median")
+    timed = int(os.environ.get("BENCH_KSWEEP_ROUNDS", "2"))
+
+    import jax
+    import jax.numpy as jnp
+
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+    from byzantine_aircomp_tpu.obs import hbm as hbm_lib
+    from byzantine_aircomp_tpu.obs.profile import device_memory
+
+    platform = jax.default_backend()
+    log(f"stream_ksweep: backend={platform} Ks={ks} cohort={cohort} "
+        f"agg={agg} timed={timed}")
+    for k in ks:
+        if k % cohort:
+            log(f"stream_ksweep: skipping K={k} "
+                f"(not divisible by cohort {cohort})")
+            continue
+        cfg = FedConfig(
+            honest_size=k,
+            byz_size=0,
+            agg=agg,
+            cohort_size=cohort,
+            rounds=1 + timed,
+            display_interval=1,
+            batch_size=8,
+            eval_train=False,
+            agg_maxiter=100,
+        )
+        ds = data_lib.load("mnist", synthetic_train=4 * k, synthetic_val=256)
+        trainer = FedTrainer(cfg, dataset=ds)
+        trainer.run_rounds(0, 1)  # compile + one warmup round
+        float(jnp.sum(trainer.flat_params))
+        t0 = time.perf_counter()
+        trainer.run_rounds(1, timed)
+        float(jnp.sum(trainer.flat_params))  # honest completion barrier
+        dt = time.perf_counter() - t0
+        mem = device_memory()
+        row = make_bench_row(
+            timed / dt,
+            platform=platform,
+            timed_rounds=timed,
+            params={
+                "k": k, "b": 0, "agg": agg, "attack": None,
+                "dataset": "mnist", "model": "MLP",
+                "metric": "stream_ksweep",
+            },
+        )
+        row["cohort_size"] = cohort
+        row["d"] = int(trainer.dim)
+        row["peak_measured_bytes"] = int(mem["peak_bytes_in_use"])
+        row["peak_source"] = str(mem["source"])
+        row["peak_streamed_modeled_bytes"] = hbm_lib.streamed_peak_bytes(
+            k, trainer.dim, cohort
+        )
+        row["peak_resident_modeled_bytes"] = hbm_lib.modeled_peak_bytes(
+            k, trainer.dim
+        )
+        log(
+            f"stream_ksweep: K={k} d={trainer.dim} {timed / dt:.3f} "
+            f"rounds/sec, peak {mem['peak_bytes_in_use']} B "
+            f"({mem['source']}), streamed model "
+            f"{row['peak_streamed_modeled_bytes']} B, resident model "
+            f"{row['peak_resident_modeled_bytes']} B"
+        )
+        emit_row(row)
+
+
+# --------------------------------------------------------------------------
 # parent: probe + dispatch (never initializes a backend, cannot hang)
 # --------------------------------------------------------------------------
 
@@ -248,6 +362,35 @@ def _probe_backend(timeout: float | None):
         return None
     log(f"probe: backend={info['backend']} devices={info['n']} init={time.perf_counter() - t0:.1f}s")
     return info
+
+
+def _probe_backend_with_retry(timeout: float | None):
+    """Probe with retries: ``(info_or_None, per_attempt_diagnostics)``.
+
+    Round-1 postmortem addendum: a relay restart wedges init for ~seconds,
+    not forever — one probe attempt at the wrong moment condemned a whole
+    bench run to the CPU fallback.  Retry ``BENCH_PROBE_RETRIES`` times
+    (default 2 extra attempts) with ``BENCH_PROBE_BACKOFF_SECS`` between
+    them (default 15), and classify the relay after EVERY failed attempt:
+    the diagnostics list distinguishes "relay dead the whole time" from
+    "wedged once, listening later" in the final ``fallback_reason``.
+    """
+    from byzantine_aircomp_tpu.utils.env import diagnose_relay
+
+    retries = max(int(os.environ.get("BENCH_PROBE_RETRIES", "2")), 0)
+    backoff = float(os.environ.get("BENCH_PROBE_BACKOFF_SECS", "15"))
+    diagnostics: list[str] = []
+    for attempt in range(1 + retries):
+        if attempt:
+            log(f"probe: retry {attempt}/{retries} after {backoff:.0f}s backoff")
+            time.sleep(backoff)
+        info = _probe_backend(timeout)
+        if info is not None:
+            return info, diagnostics
+        relay = diagnose_relay()
+        diagnostics.append(f"attempt {attempt + 1}: relay {relay}")
+        log(f"probe: attempt {attempt + 1} failed, relay {relay}")
+    return None, diagnostics
 
 
 def _run_bench_child(env: dict, timeout: float | None, timed_rounds: int):
@@ -287,6 +430,9 @@ def main() -> None:
     if os.environ.get("BENCH_CHILD"):
         run_child()
         return
+    if os.environ.get("BENCH_STREAM_KSWEEP"):
+        run_stream_ksweep()
+        return
 
     def _secs(name: str, default: str) -> float | None:
         # 0 disables the stage watchdog (the legacy BENCH_WATCHDOG_SECS
@@ -302,7 +448,7 @@ def main() -> None:
 
     probe_desc = "disabled" if probe_secs is None else f"{probe_secs:.0f}s"
     log(f"probing device backend (timeout {probe_desc})")
-    info = _probe_backend(probe_secs)
+    info, probe_diags = _probe_backend_with_retry(probe_secs)
 
     fallback_reason = None
     relay = None
@@ -315,12 +461,14 @@ def main() -> None:
                 "cpu fallback"
             )
     elif info is None:
-        from byzantine_aircomp_tpu.utils.env import diagnose_relay
-
-        relay = diagnose_relay()
+        # the LAST attempt's classification is the headline relay state;
+        # the per-attempt trail rides in fallback_reason so the artifact
+        # distinguishes dead-throughout from transiently-wedged
+        relay = probe_diags[-1].split("relay ", 1)[-1] if probe_diags else None
         fallback_reason = (
-            f"tunnel failure (relay {relay}): backend init did not complete "
-            f"in {probe_desc}; cpu fallback"
+            f"tunnel failure ({'; '.join(probe_diags)}): backend init did "
+            f"not complete in {probe_desc} over {len(probe_diags)} probe "
+            "attempt(s); cpu fallback"
         )
     else:
         fallback_reason = "no accelerator visible (cpu-only env); cpu fallback"

@@ -227,6 +227,9 @@ def main(argv=None) -> int:
         "metric": "agg_epilogue_summary", "platform": backend,
         "fused_impl": fused_impl,
         "pallas_vmem_ok": pk.supports_sort_fused(k, channel=True),
+        # None when the kernel fits; otherwise the spelled-out VMEM math so
+        # a select-only matrix is attributable from this row alone
+        "pallas_vmem_reason": pk.sort_fused_reason(k, channel=True),
         "fused_hbm_x_pallas": round(pallas_hbm_x, 3),
         "sort_hbm_x": round(hbm_model("sort", k, d, b, False) / stack_bytes, 3),
         "single_hbm_pass": pallas_hbm_x <= 1.1,

@@ -42,6 +42,7 @@ from ..obs.ledger import (
     DEFAULT_MAD_SIGMAS,
     DEFAULT_REL_TOL,
     DEFAULT_WINDOW,
+    LEDGER_EXTRA_FIELDS,
     PerfLedger,
     config_key,
 )
@@ -234,12 +235,18 @@ def main(argv=None) -> int:
     )
     code = _exit_code(verdict["verdict"], args.strict_platform)
     if args.append and verdict["verdict"] != "regression":
+        # descriptive columns (the stream_ksweep peak-bytes fields) ride
+        # along so a gated append is as self-describing as a direct one
+        extra = {
+            f: row[f] for f in LEDGER_EXTRA_FIELDS if row.get(f) is not None
+        }
         ledger.append(
             str(row["metric"]), float(row["value"]),
             unit=str(row.get("unit", "")),
             platform=str(row.get("platform", "unknown")),
             key=config_key(row),
             note=str(row.get("note", "")) or "perf_gate --append",
+            **extra,
         )
     if args.json:
         print(json.dumps(verdict, indent=2))

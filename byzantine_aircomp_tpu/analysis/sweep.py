@@ -219,6 +219,9 @@ def main(argv=None) -> None:
         defense_up=args.defense_up,
         defense_down=args.defense_down,
         defense_min_flagged=args.defense_min_flagged,
+        cohort_size=args.cohort_size,
+        cohort_quantile=args.cohort_quantile,
+        cohort_sketch_bins=args.cohort_sketch_bins,
     )
     # stdout keeps one JSON object per completed cell (the shape scripts
     # already parse — schema stamps v/kind/ts are additive); --obs-dir tees

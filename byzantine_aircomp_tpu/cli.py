@@ -47,6 +47,20 @@ def add_knob_flags(p) -> None:
                    help="server-side bucketing (Karimireddy 2022): "
                         "aggregate means of random s-client buckets — the "
                         "standard non-IID fix for median/krum; 1 = off")
+    p.add_argument("--cohort-size", type=int, default=0,
+                   help="stream the round over client chunks of this size "
+                        "instead of the resident [K, d] stack (peak HBM "
+                        "O(cohort*d)); must divide honest and Byzantine "
+                        "sizes; 0 = resident path, bit-identical records")
+    p.add_argument("--cohort-quantile", choices=["exact", "sketch"],
+                   default="exact",
+                   help="streamed median/trimmed_mean rung: exact "
+                        "key-bisection (32 counting passes, resident-rank "
+                        "parity) or mergeable histogram sketch (3 passes, "
+                        "bounded bucket-width error)")
+    p.add_argument("--cohort-sketch-bins", type=int, default=512,
+                   help="histogram resolution of the quantile sketch "
+                        "(--cohort-quantile sketch)")
     p.add_argument("--attack-param", type=float, default=None,
                    help="scalar attack magnitude (alie z / ipm eps / gaussian "
                         "sigma / minmax+minsum fixed gamma)")
@@ -136,6 +150,9 @@ ARG_TO_FIELD = {
     "dirichlet_alpha": ("dirichlet_alpha", None),
     "participation": ("participation", None),
     "bucket_size": ("bucket_size", None),
+    "cohort_size": ("cohort_size", None),
+    "cohort_quantile": ("cohort_quantile", None),
+    "cohort_sketch_bins": ("cohort_sketch_bins", None),
     "client_momentum": ("client_momentum", None),
     "attack_param": ("attack_param", None),
     "krum_m": ("krum_m", None),

@@ -206,6 +206,26 @@ class FedConfig:
     # each bucket.  1 = off (reference behavior); the participating
     # client count must be divisible by s
     bucket_size: int = 1
+    # streaming cohort aggregation: when > 0 the round never materializes
+    # the full [K, d] client stack — a lax.scan over cohort_size-client
+    # chunks rebuilds each chunk on demand and feeds streaming/mergeable
+    # aggregates (ops/aggregators.stream_aggregate), so peak HBM is
+    # O(cohort*d) instead of O(K*d).  0 (default) takes the resident code
+    # path verbatim: bit-identical records, RNG stream and config_hash.
+    # Must divide both honest_size and byz_size so every chunk is purely
+    # honest or purely Byzantine (honest chunks trace no attack code);
+    # requires a streamable aggregator (mean/median/trimmed_mean/gm2), a
+    # row-local or data-level attack, and a fault without the [K, d]
+    # stale-replay buffer (see validate below)
+    cohort_size: int = 0
+    # streamed median/trimmed_mean realization: "exact" = total-order-key
+    # bisection (32 counting passes over the cohort scan; identical ranks
+    # to the resident selection epilogue, the parity fallback) or
+    # "sketch" = mergeable key-space histogram (3 passes; error bounded
+    # by the histogram bucket width, docs/DESIGN.md)
+    cohort_quantile: str = "exact"
+    # histogram resolution of the quantile sketch ([bins, d] i32 carry)
+    cohort_sketch_bins: int = 512
 
     def participant_counts(self) -> tuple:
         """(honest, Byzantine) rows per iteration — the single source of
@@ -297,6 +317,11 @@ class FedConfig:
         "defense_cusum", "defense_z", "defense_up", "defense_down",
         "defense_min_flagged",
     )
+
+    # cohort knobs that require cohort_size > 0 (fault-knob contract);
+    # harness.config_hash also reads this tuple to keep the hash of every
+    # cohort-off config identical to pre-streaming builds
+    _COHORT_KNOBS = ("cohort_quantile", "cohort_sketch_bins")
 
     def defense_ladder_names(self) -> tuple:
         """The escalation ladder as a tuple of aggregator names."""
@@ -506,6 +531,94 @@ class FedConfig:
                 self.defense_ladder_names(),
                 self.agg if self.defense == "adaptive" else None,
             )
+        assert self.cohort_size >= 0, (
+            f"cohort_size must be >= 0, got {self.cohort_size}"
+        )
+        if self.cohort_size == 0:
+            # fault-knob contract: tuning a cohort knob without enabling
+            # the streamed path would silently do nothing
+            defaults = {f.name: f.default for f in dataclasses.fields(self)}
+            touched = sorted(
+                k for k in self._COHORT_KNOBS
+                if getattr(self, k) != defaults[k]
+            )
+            assert not touched, (
+                f"cohort knobs {touched} require --cohort-size > 0 (they "
+                f"configure the streamed quantile rung and would otherwise "
+                f"silently do nothing)"
+            )
+        else:
+            assert self.cohort_quantile in ("exact", "sketch"), (
+                f"cohort_quantile must be 'exact' or 'sketch', "
+                f"got {self.cohort_quantile!r}"
+            )
+            assert self.cohort_sketch_bins >= 2, (
+                f"cohort_sketch_bins must be >= 2, got "
+                f"{self.cohort_sketch_bins}"
+            )
+            assert self.honest_size % self.cohort_size == 0 and (
+                self.byz_size % self.cohort_size == 0
+            ), (
+                f"cohort_size {self.cohort_size} must divide both "
+                f"honest_size {self.honest_size} and byz_size "
+                f"{self.byz_size}: each streamed chunk must be purely "
+                f"honest or purely Byzantine (honest chunks trace no "
+                f"attack code)"
+            )
+            assert self.participation == 1.0, (
+                "streaming cohorts require full participation: the cohort "
+                "scan walks the full [K] client index space in chunks"
+            )
+            assert self.bucket_size == 1, (
+                "bucketing shuffles rows ACROSS cohorts before "
+                "aggregation, which needs the resident stack; use "
+                "--cohort-size 0 with --bucket-size"
+            )
+            assert self.client_momentum == 0.0, (
+                "client_momentum carries a resident [K, d] state buffer — "
+                "exactly the allocation the streamed path removes"
+            )
+            assert self.stack_dtype == "f32", (
+                "the streamed selection rung bisects f32 total-order keys; "
+                "bf16 chunks are not supported (--cohort-size 0 for bf16)"
+            )
+            assert self.fused_epilogue != "on", (
+                "the fused sort-family epilogue reads the resident [K, d] "
+                "stack in one pass — it cannot apply to a streamed round "
+                "(the cohort scan IS the single pass); leave it 'auto'"
+            )
+            from ..ops import aggregators as agg_lib
+
+            for rung in {self.agg, *(
+                self.defense_ladder_names()
+                if self.defense == "adaptive" else ()
+            )}:
+                assert agg_lib.streamable(rung), (
+                    f"aggregator {rung!r} has no streaming/mergeable "
+                    f"formulation (needs the resident [K, d] stack); "
+                    f"streamable: mean, median, trimmed_mean, gm2"
+                )
+            if self.attack is not None:
+                from ..ops import attacks as attack_lib
+
+                spec = attack_lib.resolve(self.attack)
+                assert attack_lib.streamable(spec), (
+                    f"attack {self.attack!r} is omniscient (reads the "
+                    f"honest rows of the resident stack) and cannot run "
+                    f"under cohort streaming; row-local/data-level "
+                    f"attacks (signflip, gaussian, classflip, dataflip, "
+                    f"gradascent) stream fine"
+                )
+            if self.fault is not None:
+                from ..ops import faults as fault_lib
+
+                spec = fault_lib.resolve(self.fault, self.fault_overrides())
+                assert not spec.needs_stale, (
+                    f"fault {self.fault!r} keeps a resident [K, d] "
+                    f"stale-replay buffer (dropout_prob > 0) — exactly "
+                    f"the allocation the streamed path removes; deep_fade/"
+                    f"csi/corrupt stream fine"
+                )
         return self
 
 

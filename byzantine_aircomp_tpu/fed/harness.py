@@ -135,6 +135,14 @@ def run_title(cfg: FedConfig) -> str:
         title += f"_part{cfg.participation}"
     if cfg.bucket_size > 1:
         title += f"_bkt{cfg.bucket_size}"
+    if cfg.cohort_size > 0:
+        # the streamed round reorders float accumulation (and re-keys the
+        # per-cohort channel/batch draws), so it must never alias the
+        # resident trajectory on checkpoints/pickles
+        title += f"_cohort{cfg.cohort_size}"
+        for knob in FedConfig._COHORT_KNOBS:
+            if _non_default(cfg, knob):
+                title += f"_{knob.replace('cohort_', '')}{getattr(cfg, knob)}"
     if _non_default(cfg, "prng_impl"):
         title += f"_prng{cfg.prng_impl}"
     if _non_default(cfg, "stack_dtype"):
@@ -196,6 +204,12 @@ def config_hash(cfg: FedConfig) -> str:
         # validate() pins every defense knob to its default when the
         # defense is off, so skipping them drops no information
         skip = skip + ("defense",) + FedConfig._DEFENSE_KNOBS
+    if cfg.cohort_size == 0:
+        # same continuity contract as the defense block: a cohort-off
+        # config must hash identically to builds that predate the
+        # streaming fields (validate() pins the cohort knobs to their
+        # defaults when cohort_size is 0, so skipping drops nothing)
+        skip = skip + ("cohort_size",) + FedConfig._COHORT_KNOBS
     items = sorted(
         (f.name, repr(getattr(cfg, f.name)))
         for f in dataclasses.fields(cfg)
@@ -474,9 +488,27 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
                 getattr(ds, "x_val", None), getattr(ds, "y_val", None),
             )
         )
-        modeled = hbm_lib.modeled_peak_bytes(
-            cfg.node_size, trainer.dim, data_bytes=data_bytes
-        )
+        if cfg.cohort_size > 0:
+            # streamed rounds never hold the [K, d] stack: the watermark is
+            # judged against the O(cohort*d + K) streamed model, with the
+            # surviving per-client state (defense [K] f32 detector rows,
+            # fault GE bools) accounted per-feature
+            state_pc = 0
+            if cfg.defense != "off":
+                state_pc += 3 * 4  # detector ema/dev/cusum [K] f32
+            if cfg.fault is not None:
+                state_pc += 1  # Gilbert-Elliott bad-state bools [K]
+            modeled = hbm_lib.streamed_peak_bytes(
+                cfg.node_size, trainer.dim, cfg.cohort_size,
+                data_bytes=data_bytes,
+                state_bytes_per_client=state_pc,
+            )
+            memory["hbm_model"] = "streamed"
+        else:
+            modeled = hbm_lib.modeled_peak_bytes(
+                cfg.node_size, trainer.dim, data_bytes=data_bytes
+            )
+            memory["hbm_model"] = "resident"
         memory["modeled_peak_bytes"] = modeled
         memory["warn_factor"] = cfg.hbm_warn_factor
         exceeds = (
@@ -489,8 +521,8 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
                 "WARNING: measured device peak "
                 f"{memory['peak_bytes_in_use']} bytes exceeds "
                 f"{cfg.hbm_warn_factor:g}x the modeled peak {modeled} bytes "
-                "(obs/hbm.modeled_peak_bytes) — an allocation the model "
-                "does not account for is resident"
+                f"(obs/hbm {memory['hbm_model']} model) — an allocation "
+                "the model does not account for is resident"
             )
     obs.emit(
         "run_end",

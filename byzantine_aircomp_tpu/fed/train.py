@@ -446,7 +446,16 @@ class FedTrainer:
         iteration emits ``(variance, [dropped, erased, corrupt,
         effective_k])``; every fault stage is gated at TRACE time on
         ``self.fault``, so the fault-free program (structure, RNG stream,
-        outputs) is bit-identical to the pre-fault one."""
+        outputs) is bit-identical to the pre-fault one.
+
+        ``cfg.cohort_size > 0`` swaps in the cohort-streamed body
+        (:meth:`_iteration_streamed`) at trace time; at 0 this resident
+        body is traced verbatim, so the default program is bit-identical
+        to builds that predate streaming."""
+        if self.cfg.cohort_size > 0:
+            return self._iteration_streamed(
+                carry, key, x_train, y_train, want_variance
+            )
         cfg = self.cfg
         (
             flat_params, opt_state, client_m, fault_state, defense_state,
@@ -737,6 +746,306 @@ class FedTrainer:
             fault_metrics = jnp.stack(
                 [n_dropped, n_erased, n_corrupt, eff_k]
             )
+        else:
+            fault_metrics = ()
+        return carry_out, (variance, fault_metrics, defense_metrics)
+
+    def _iteration_streamed(self, carry, key, x_train, y_train, want_variance):
+        """Cohort-streamed global iteration: K >> HBM.
+
+        Never materializes the [K, d] stack.  ``rebuild_full(c)`` recomputes
+        one ``cohort_size``-client chunk end to end (local steps -> message
+        attack -> fault transmission -> channel) as a pure function of the
+        cohort index; ONE observation ``lax.scan`` over the chunks collects
+        the streaming accumulators (masked sums / finite count), the honest
+        dispersion moments, the fault counters + Gilbert-Elliott write-backs
+        and the per-chunk defense detector updates; the aggregate itself
+        comes from ``ops/aggregators.stream_aggregate``, whose passes
+        re-invoke ``rebuild`` — trading recompute for an O(cohort*d) peak
+        (obs/hbm.streamed_peak_bytes) instead of O(K*d).
+
+        Contracts (enforced by ``FedConfig.validate``): full participation,
+        no bucketing, no client momentum, f32 stack, cohort_size divides
+        both honest_size and byz_size (every chunk purely honest or purely
+        Byzantine), streamable aggregator/ladder, row-local attack, no
+        stale-replay fault.  The round-level key split matches the resident
+        path exactly (same count, same order — checkpoint key streams are
+        invariant), and the batch-index draw reuses the resident key and
+        shape, so with channel/fault off the rebuilt rows are bit-identical
+        to the resident stack's.  Per-chunk channel/fault/attack-noise
+        draws come from ``channel.cohort_key`` fold-ins — those
+        REALIZATIONS differ from the resident path (a fresh draw every
+        round either way), which is why ``--cohort-size`` forks the
+        run_title/config_hash lineage.
+
+        Defense note: ``client_scores`` medians/centroids run per cohort
+        rather than over the full K — a documented approximation (honest
+        cohorts are i.i.d. slices, so the cohort median estimates the same
+        honest baseline); detector state is still per-client and exact.
+        """
+        cfg = self.cfg
+        (
+            flat_params, opt_state, client_m, fault_state, defense_state,
+            attack_iter,
+        ) = carry
+        m_h, m_b = self._part_h, self._part_b  # == honest/byz (full part.)
+        cohort = cfg.cohort_size
+        n_h_chunks = m_h // cohort
+        n_chunks = n_h_chunks + m_b // cohort
+        d = self.dim
+        k_total = m_h + m_b
+
+        attack_on = None
+        if self._attack_onset is not None:
+            attack_on = attack_iter >= self._attack_onset
+
+        # identical round-level split to the resident path (replay/ckpt
+        # compatible); chunk sub-streams below are cohort_key fold-ins
+        n_extra = int(self.fault is not None)
+        keys = jax.random.split(key, 4 + n_extra)
+        k_batch, k_chan, k_agg, k_msg = keys[:4]
+        del k_agg  # mean/median/trimmed_mean/gm2 never consume it
+        stale = ge_bad = ()
+        if self.fault is not None:
+            _k_drop, k_trans = jax.random.split(keys[4])
+            stale, ge_bad = fault_state  # stale is () (needs_stale rejected)
+        byz_mask = self._part_mask
+        steps_b = cfg.local_steps * cfg.batch_size
+        # ONE [K, E*B] index draw under the resident path's exact key and
+        # shape — i32 indices are O(K*batch), not O(K*d), so keeping them
+        # resident costs nothing against the streamed peak and makes every
+        # chunk's batches (hence, with channel/fault off, the chunk rows
+        # themselves) bit-identical to the resident stack's rows
+        idx_all = data_lib.sample_client_batch_indices(
+            k_batch, self.offsets, self.sizes, steps_b
+        )
+
+        def rebuild_full(c_idx):
+            """([cohort, d] chunk, new GE slice, n_erased, n_corrupt) for
+            one cohort — pure in c_idx, so every aggregator pass that
+            re-invokes it sees identical chunks."""
+            off = c_idx * cohort
+            mask_c = jax.lax.dynamic_slice_in_dim(byz_mask, off, cohort)
+            if attack_on is not None:
+                mask_c = mask_c & attack_on
+            idx = jax.lax.dynamic_slice_in_dim(idx_all, off, cohort, axis=0)
+            x = x_train[idx]
+            if self._norm_scale is not None:
+                x = x.astype(jnp.float32) * self._norm_scale + self._norm_bias
+            shape = (cohort, cfg.local_steps, cfg.batch_size)
+            x = x.reshape(
+                shape + (self._sample_shape if self._spatial_input else (-1,))
+            )
+            y = y_train[idx].reshape(shape)
+            chunk = self._constrain_stack(
+                self._client_stack(flat_params, x, y, mask_c)
+            )
+
+            if self.attack is not None and self.attack.message_fn is not None:
+                # cohort purity: byz chunks are the LAST ones, so byz_size =
+                # cohort attacks the whole chunk and the scalar gate keeps
+                # honest chunks untouched (row-local attacks only —
+                # cfg.validate rejects the omniscient ones)
+                is_byz_chunk = c_idx >= n_h_chunks
+                w_att = self.attack.apply_message(
+                    chunk, cohort, channel_lib.cohort_key(k_msg, c_idx),
+                    param=cfg.attack_param,
+                )
+                gate = (
+                    is_byz_chunk if attack_on is None
+                    else jnp.logical_and(is_byz_chunk, attack_on)
+                )
+                chunk = jnp.where(gate, w_att, chunk)
+
+            ge_c = ()
+            n_erased = n_corrupt = jnp.float32(0.0)
+            if self.fault is not None:
+                ge_in = (
+                    jax.lax.dynamic_slice_in_dim(ge_bad, off, cohort)
+                    if self.fault.needs_ge
+                    else ()
+                )
+                chunk, ge_c, n_erased, n_corrupt = (
+                    fault_lib.apply_transmission(
+                        self.fault, channel_lib.cohort_key(k_trans, c_idx),
+                        chunk, ge_in, row_offset=off,
+                    )
+                )
+
+            if cfg.noise_var is not None and agg_lib.needs_oma_prepass(
+                cfg.agg
+            ):
+                chunk = channel_lib.oma(
+                    channel_lib.cohort_key(k_chan, c_idx), chunk,
+                    cfg.noise_var,
+                )
+            return self._constrain_stack(chunk), ge_c, n_erased, n_corrupt
+
+        def rebuild(c_idx):
+            return rebuild_full(c_idx)[0]
+
+        # ---- single observation pass over the chunks
+        needs_ge = self.fault is not None and self.fault.needs_ge
+        if self.defense is not None:
+            det, pol = defense_state
+        obs_init = (
+            jnp.zeros(d, jnp.float32),   # sum over all rows
+            jnp.zeros(d, jnp.float32),   # sum over finite rows
+            jnp.int32(0),                # finite-row count
+            jnp.zeros(d, jnp.float32),   # honest-row sum (dispersion)
+            jnp.float32(0.0),            # honest sum of squared norms
+            ge_bad if needs_ge else (),
+            jnp.float32(0.0),            # erased
+            jnp.float32(0.0),            # corrupt
+            (det[1], det[2], det[3]) if self.defense is not None else (),
+            jnp.int32(0) if self.defense is not None else (),
+            jnp.float32(0.0) if self.defense is not None else (),
+        )
+
+        def obs_body(carry_o, c_idx):
+            (
+                s_all, s_fin, n_fin, s_h, ssq_h, ge_acc, n_er, n_co,
+                det_rows, n_flag, max_sc,
+            ) = carry_o
+            chunk, ge_c, er, co = rebuild_full(c_idx)
+            fin = agg_lib._finite_rows(chunk)
+            c32 = chunk.astype(jnp.float32)
+            s_all = s_all + jnp.sum(c32, axis=0)
+            s_fin = s_fin + jnp.sum(
+                jnp.where(fin[:, None], c32, 0.0), axis=0
+            )
+            n_fin = n_fin + jnp.sum(fin)
+            is_h = (c_idx < n_h_chunks).astype(jnp.float32)
+            s_h = s_h + is_h * jnp.sum(c32, axis=0)
+            ssq_h = ssq_h + is_h * jnp.sum(c32 * c32)
+            if self.fault is not None:
+                n_er, n_co = n_er + er, n_co + co
+                if needs_ge:
+                    ge_acc = jax.lax.dynamic_update_slice_in_dim(
+                        ge_acc, ge_c, c_idx * cohort, axis=0
+                    )
+            if self.defense is not None:
+                # per-client detector rows, updated slice-by-slice under
+                # the shared scalar step (incremented ONCE after the scan)
+                ema, dev, cus = det_rows
+                off = c_idx * cohort
+                det_c = (
+                    det[0],
+                    jax.lax.dynamic_slice_in_dim(ema, off, cohort),
+                    jax.lax.dynamic_slice_in_dim(dev, off, cohort),
+                    jax.lax.dynamic_slice_in_dim(cus, off, cohort),
+                )
+                score, score_fin = defense_lib.client_scores(
+                    chunk, flat_params
+                )
+                (_, ema_c, dev_c, cus_c), flags = (
+                    defense_lib.detector_update(
+                        det_c, score, score_fin, self.defense.detector
+                    )
+                )
+                det_rows = (
+                    jax.lax.dynamic_update_slice_in_dim(
+                        ema, ema_c, off, axis=0
+                    ),
+                    jax.lax.dynamic_update_slice_in_dim(
+                        dev, dev_c, off, axis=0
+                    ),
+                    jax.lax.dynamic_update_slice_in_dim(
+                        cus, cus_c, off, axis=0
+                    ),
+                )
+                n_flag = n_flag + jnp.sum(flags)
+                max_sc = jnp.maximum(max_sc, jnp.max(score))
+            return (
+                s_all, s_fin, n_fin, s_h, ssq_h, ge_acc, n_er, n_co,
+                det_rows, n_flag, max_sc,
+            ), None
+
+        with jax.named_scope("stream_observe"):
+            (
+                s_all, s_fin, n_fin, s_h, ssq_h, ge_new, n_er, n_co,
+                det_rows, n_flag, max_sc,
+            ), _ = jax.lax.scan(
+                obs_body, obs_init, jnp.arange(n_chunks, dtype=jnp.int32)
+            )
+        if self.fault is not None:
+            fault_state = (stale, ge_new if needs_ge else ge_bad)
+
+        defense_metrics = ()
+        rung = None
+        if self.defense is not None:
+            det = (det[0] + 1, det_rows[0], det_rows[1], det_rows[2])
+            pol, suspicious = defense_lib.policy_update(
+                pol, n_flag, self.defense.policy
+            )
+            rung = pol[0]
+            defense_state = (det, pol)
+            defense_metrics = jnp.stack([
+                rung.astype(jnp.float32),
+                n_flag.astype(jnp.float32),
+                suspicious.astype(jnp.float32),
+                max_sc,
+                jnp.max(det[3]),
+            ])
+
+        with jax.named_scope("stream_aggregate"):
+            kw = dict(
+                k=k_total, d=d, n_chunks=n_chunks,
+                degraded=self.fault is not None,
+                sum_all=s_all, sum_finite=s_fin, n_finite=n_fin,
+                guess=flat_params, maxiter=cfg.agg_maxiter,
+                tol=cfg.agg_tol, quantile=cfg.cohort_quantile,
+                sketch_bins=cfg.cohort_sketch_bins,
+            )
+            if self.defense is not None and self.defense.mode == "adaptive":
+                # streamed rung dispatch: one lax.switch over nullary
+                # streamed closures (cfg.validate pins every rung to a
+                # streamable aggregator)
+                branches = tuple(
+                    (lambda nm: lambda: agg_lib.stream_aggregate(
+                        nm, rebuild, **kw
+                    ))(nm)
+                    for nm in self.defense.ladder
+                )
+                aggregated = jax.lax.switch(rung, branches)
+            else:
+                aggregated = agg_lib.stream_aggregate(cfg.agg, rebuild, **kw)
+            aggregated = aggregated.astype(jnp.float32)
+            if self.fault is not None:
+                # same receiver-side finite-guard as the resident path
+                aggregated = jnp.where(
+                    jnp.isfinite(aggregated), aggregated, flat_params
+                )
+            if self._server_tx is not None:
+                delta = flat_params - aggregated
+                updates, opt_state = self._server_tx.update(
+                    delta, opt_state, flat_params
+                )
+                new_flat = optax.apply_updates(flat_params, updates)
+            else:
+                new_flat = aggregated
+            new_flat = self._constrain_params(new_flat)
+
+        # streamed honest dispersion from the observation-pass moments:
+        # (1/H) sum ||w_i||^2 - ||mean_h||^2 == mean_i ||w_i - mean_h||^2
+        mean_h = s_h / jnp.float32(m_h)
+        variance = jnp.where(
+            want_variance,
+            ssq_h / jnp.float32(m_h) - jnp.sum(mean_h * mean_h),
+            jnp.float32(0.0),
+        )
+        if self._attack_onset is not None:
+            attack_iter = attack_iter + 1
+        carry_out = (
+            new_flat, opt_state, client_m, fault_state, defense_state,
+            attack_iter,
+        )
+        if self.fault is not None:
+            # dropout is structurally absent under streaming (needs_stale
+            # rejected), so the dropped count is a literal 0
+            fault_metrics = jnp.stack([
+                jnp.float32(0.0), n_er, n_co, n_fin.astype(jnp.float32),
+            ])
         else:
             fault_metrics = ()
         return carry_out, (variance, fault_metrics, defense_metrics)

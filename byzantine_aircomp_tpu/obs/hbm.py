@@ -102,6 +102,46 @@ def aggregator_hbm_model(
     }
 
 
+def streamed_peak_bytes(
+    k: int,
+    d: int,
+    cohort: int,
+    *,
+    dtype_bytes: int = 4,
+    data_bytes: int = 0,
+    chunk_copies: int = 3,
+    param_copies: int = 6,
+    state_bytes_per_client: int = 0,
+) -> int:
+    """Peak-allocation model for the COHORT-STREAMED round program
+    (``--cohort-size > 0``) — the counterpart of :func:`modeled_peak_bytes`
+    whose resident [K, d] stack term is replaced by the streamed carry:
+
+    * ``chunk_copies`` [cohort, d] buffers — the rebuilt chunk, its
+      per-chunk transform transient (attack/channel ``where``), and the
+      per-client local-step batch working set, all of which XLA reuses
+      across scan steps;
+    * ``param_copies`` [d] f32 vectors — params plus the scan-carried
+      streaming accumulators (sum_all / sum_finite / Weiszfeld num /
+      bisection lo+hi rows);
+    * ``state_bytes_per_client`` * K — the surviving O(K) per-client state
+      (defense detector [K] rows, Gilbert-Elliott bools); 0 when those
+      features are off;
+    * ``data_bytes`` — the uploaded dataset, unchanged by streaming.
+
+    Peak scales as O(cohort*d + d + K), never O(K*d): the quantity the
+    K-sweep acceptance demo and the harness watermark cross-check read.
+    """
+    chunk = cohort * d * dtype_bytes
+    params = d * dtype_bytes
+    return (
+        chunk_copies * chunk
+        + param_copies * params
+        + state_bytes_per_client * k
+        + data_bytes
+    )
+
+
 def modeled_peak_bytes(
     k: int,
     d: int,

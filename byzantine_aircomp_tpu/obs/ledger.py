@@ -43,6 +43,19 @@ DEFAULT_LEDGER_PATH = "docs/perf_ledger.jsonl"
 #: ts, value) — mirrors the config_hash philosophy at bench granularity
 CONFIG_KEY_FIELDS = ("k", "b", "agg", "attack", "dataset", "model")
 
+#: descriptive row fields worth carrying INTO the ledger when present —
+#: not part of the config key, but they make a row self-describing (the
+#: stream_ksweep rows' peak-bytes columns live here: measured watermark
+#: plus the obs/hbm.py streamed and resident models)
+LEDGER_EXTRA_FIELDS = (
+    "cohort_size",
+    "d",
+    "peak_measured_bytes",
+    "peak_source",
+    "peak_streamed_modeled_bytes",
+    "peak_resident_modeled_bytes",
+)
+
 #: relative band half-width tolerated as noise (±10%)
 DEFAULT_REL_TOL = 0.10
 #: MAD multiples folded into the band (1.4826 * MAD ~ sigma for normals)

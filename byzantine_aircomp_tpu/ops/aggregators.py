@@ -27,6 +27,7 @@ returns one row, like the reference).  All are jit/vmap/shard_map friendly.
 from __future__ import annotations
 
 import math
+import warnings
 from typing import Optional
 
 import jax
@@ -69,7 +70,7 @@ def _finite_centroid(wmatrix, finite):
     ) / jnp.maximum(jnp.sum(finite), 1.0)
 
 
-@AGGREGATORS.register("mean", extra_args=())
+@AGGREGATORS.register("mean", streamable=True, extra_args=())
 def mean(wmatrix: jnp.ndarray, *, degraded: bool = False, **_) -> jnp.ndarray:
     """Column mean (reference ``mean``, ``:186-187``).
 
@@ -174,6 +175,24 @@ def _select_trimmed_mean(wmatrix: jnp.ndarray, b: int) -> jnp.ndarray:
     return total / jnp.float32(k - 2 * b)
 
 
+def _sort_fused_ok(k: int, channel: bool) -> bool:
+    """Trace-time pallas-vs-bisection gate for the selection epilogue, with
+    the rejection SURFACED: when a requested pallas realization misses the
+    VMEM budget, the spelled-out byte math (``pallas_kernels
+    .sort_fused_reason``) goes to the warning stream — which the harness
+    condenses into the run log — so the fallback matrix row is
+    attributable without re-deriving the K ceiling by hand."""
+    reason = pallas_kernels.sort_fused_reason(k, channel)
+    if reason is not None:
+        warnings.warn(
+            "fused selection epilogue: pallas rejected, using the XLA "
+            f"key-bisection fallback — {reason}",
+            stacklevel=3,
+        )
+        return False
+    return True
+
+
 def supports_fused_epilogue(name: str) -> bool:
     """Aggregators whose epilogue the fused dispatch below accelerates (and
     into whose stack read the OMA prepass may be folded).  gm already owns
@@ -186,6 +205,7 @@ def supports_fused_epilogue(name: str) -> bool:
 @AGGREGATORS.register(
     "median",
     supports_fused_epilogue=True,
+    streamable=True,
     extra_args=("impl", "fused_epilogue", "oma_key", "noise_var"),
 )
 def median(
@@ -218,9 +238,7 @@ def median(
     """
     k = wmatrix.shape[0]
     if fused_epilogue and not degraded and wmatrix.dtype == jnp.float32:
-        if impl == "pallas" and pallas_kernels.supports_sort_fused(
-            k, oma_key is not None
-        ):
+        if impl == "pallas" and _sort_fused_ok(k, oma_key is not None):
             ch = (
                 channel.oma_terms(oma_key, k, wmatrix.shape[1], noise_var)
                 if oma_key is not None
@@ -249,6 +267,7 @@ def median(
 @AGGREGATORS.register(
     "trimmed_mean",
     supports_fused_epilogue=True,
+    streamable=True,
     extra_args=(
         "trim_ratio", "beta", "impl", "fused_epilogue", "oma_key", "noise_var",
     ),
@@ -281,9 +300,7 @@ def trimmed_mean(
     if fused_epilogue and not degraded and wmatrix.dtype == jnp.float32:
         b = int(k * trim_ratio) if beta is None else int(beta)
         if 0 <= b and k - 2 * b >= 1:
-            if impl == "pallas" and pallas_kernels.supports_sort_fused(
-                k, oma_key is not None
-            ):
+            if impl == "pallas" and _sort_fused_ok(k, oma_key is not None):
                 ch = (
                     channel.oma_terms(oma_key, k, wmatrix.shape[1], noise_var)
                     if oma_key is not None
@@ -837,7 +854,7 @@ def _weiszfeld_dists(wmatrix, guess):
 
 
 @AGGREGATORS.register(
-    "gm2", extra_args=("guess", "maxiter", "tol", "impl")
+    "gm2", streamable=True, extra_args=("guess", "maxiter", "tol", "impl")
 )
 def gm2(
     wmatrix: jnp.ndarray,
@@ -984,6 +1001,408 @@ def gm(
         cond, body, (jnp.int32(0), init_guess, jnp.float32(jnp.inf), key)
     )
     return final
+
+
+# ---------------------------------------------------------------------------
+# streaming cohort aggregation: K >> HBM via chunked client scans
+#
+# ``stream_aggregate`` realizes the streamable aggregators without ever
+# materializing the [K, d] stack.  The trainer hands it ``rebuild(c_idx) ->
+# [cohort, d]`` — a pure function that recomputes one cohort's post-
+# attack/fault/channel chunk from the round inputs — and every algorithm
+# below is one or more ``lax.scan`` passes over the cohort index, carrying
+# only O(cohort*d + d) state:
+#
+# * mean         — running (masked) sums, normally supplied by the
+#                  trainer's single observation pass: 0 extra passes;
+#                  exact up to the float reassociation of chunk-partial
+#                  sums vs the resident column mean.
+# * gm2          — Weiszfeld where each step's two reductions
+#                  (sum w_i/d_i, sum 1/d_i) accumulate across one chunk
+#                  pass; identical DIST_CLAMP / finite-masking / stopping
+#                  semantics to the resident solver, so for a fixed guess
+#                  sequence the iterates differ only by reassociation.
+# * median /     — "exact": 32-step total-order-key bisection
+#   trimmed_mean   (_nth_smallest_keys) where each step's per-column count
+#                  is one chunk pass — the located RANK KEYS are identical
+#                  to the resident selection epilogue's, so median values
+#                  match bit-for-bit (trimmed_mean adds one boundary/
+#                  interior pass whose sums reassociate).
+#                  "sketch": a mergeable key-space histogram — a min/max
+#                  pass, then a [bins, d] histogram pass whose counts
+#                  merge by ADDITION across cohorts (the property that
+#                  makes it a valid streamed/distributed quantile
+#                  summary), then the rank's bucket via a cumulative sum;
+#                  trimmed_mean runs the same correction pass anchored at
+#                  the sketch's bucket-edge boundary estimates.  Error
+#                  bound: a located boundary key lies within one histogram
+#                  bucket (~key_span/bins in total-order-key space) above
+#                  the true order statistic's key.
+#
+# Compute trades for memory: P passes re-run the cohort rebuild (client
+# local steps included) P times.  docs/DESIGN.md "Streamed rounds" has the
+# carry layouts and the per-aggregator mergeability argument.
+
+
+def streamable(name: str) -> bool:
+    """Whether the aggregator has a streaming/mergeable realization below
+    (cohort-streamed rounds, --cohort-size > 0).  Registration metadata —
+    one source of truth for config validation and the defense ladder."""
+    return bool(AGGREGATORS.meta(name).get("streamable", False))
+
+
+def _chunk_scan(rebuild, n_chunks: int, body, init):
+    """``lax.scan`` over cohort indices: ``body(carry, chunk, c_idx) ->
+    carry`` sees each rebuilt [cohort, d] chunk exactly once.  XLA reuses
+    one chunk buffer across steps (the scan's only inter-step state is
+    ``carry``), so peak memory is one chunk plus the carry."""
+
+    def step(carry, c_idx):
+        return body(carry, rebuild(c_idx), c_idx), None
+
+    carry, _ = jax.lax.scan(
+        step, init, jnp.arange(n_chunks, dtype=jnp.int32)
+    )
+    return carry
+
+
+def stream_stats(rebuild, n_chunks: int, d: int):
+    """One pass: (sum over ALL rows [d], sum over finite rows [d],
+    finite-row count) — the accumulators mean/gm2 need, exposed so the
+    trainer's observation pass (which walks the chunks anyway) can supply
+    them to :func:`stream_aggregate` without an extra rebuild pass."""
+
+    def acc(carry, chunk, _):
+        s_all, s_fin, n_fin = carry
+        fin = _finite_rows(chunk)
+        c32 = chunk.astype(jnp.float32)
+        return (
+            s_all + jnp.sum(c32, axis=0),
+            s_fin + jnp.sum(jnp.where(fin[:, None], c32, 0.0), axis=0),
+            n_fin + jnp.sum(fin),
+        )
+
+    return _chunk_scan(
+        rebuild, n_chunks, acc,
+        (jnp.zeros(d, jnp.float32), jnp.zeros(d, jnp.float32), jnp.int32(0)),
+    )
+
+
+def _stream_count_le(rebuild, n_chunks: int, degraded: bool):
+    """count_le(mids [r, d] i32) -> [r, d] counts of total-order keys <=
+    mid per column (finite rows only when degraded) — the one-pass
+    counting primitive under the streamed key bisection."""
+
+    def count_le(mids):
+        r, d = mids.shape
+
+        def acc(cnt, chunk, _):
+            keys = pallas_kernels.total_order_keys(
+                chunk.astype(jnp.float32)
+            )
+            le = keys[None, :, :] <= mids[:, None, :]  # [r, cohort, d]
+            if degraded:
+                le = jnp.logical_and(le, _finite_rows(chunk)[None, :, None])
+            return cnt + jnp.sum(le, axis=1, dtype=jnp.int32)
+
+        return _chunk_scan(
+            rebuild, n_chunks, acc, jnp.zeros((r, d), jnp.int32)
+        )
+
+    return count_le
+
+
+def _stream_bisect_keys(count_le, ns, r: int, d: int):
+    """Streamed :func:`_nth_smallest_keys`: 32 bisection steps, each one
+    chunk-counting pass, locating the ``ns`` (0-indexed, [r] — static or
+    traced) order-statistic keys per column simultaneously."""
+    lo = jnp.full((r, d), -(2**31), jnp.int32)
+    hi = jnp.full((r, d), 2**31 - 1, jnp.int32)
+    targets = jnp.reshape(jnp.asarray(ns, jnp.int32), (r, 1))
+
+    def step(_, lohi):
+        lo, hi = lohi
+        mid = (lo >> 1) + (hi >> 1) + (lo & hi & 1)
+        cnt = count_le(mid)
+        above = cnt <= targets
+        return jnp.where(above, mid + 1, lo), jnp.where(above, hi, mid)
+
+    lo, _ = jax.lax.fori_loop(0, 32, step, (lo, hi))
+    return lo
+
+
+def _stream_sketch_keys(rebuild, n_chunks: int, d: int, ns, r: int,
+                        bins: int, degraded: bool):
+    """Mergeable quantile sketch over total-order keys: one min/max pass,
+    one [bins, d] histogram pass (per-cohort histograms merge by
+    addition), then the requested ranks' bucket UPPER EDGES via the
+    histogram's cumulative sum — so each estimate is >= the true order
+    statistic by at most one bucket width in key space."""
+    kmin0 = jnp.full((d,), 2**31 - 1, jnp.int32)
+    kmax0 = jnp.full((d,), -(2**31), jnp.int32)
+
+    def chunk_keys(chunk):
+        keys = pallas_kernels.total_order_keys(chunk.astype(jnp.float32))
+        if degraded:
+            fin = _finite_rows(chunk)[:, None]
+            return keys, fin
+        return keys, None
+
+    def minmax(carry, chunk, _):
+        kmin, kmax = carry
+        keys, fin = chunk_keys(chunk)
+        if fin is not None:
+            lo_keys = jnp.where(fin, keys, 2**31 - 1)
+            hi_keys = jnp.where(fin, keys, -(2**31))
+        else:
+            lo_keys = hi_keys = keys
+        return (
+            jnp.minimum(kmin, jnp.min(lo_keys, axis=0)),
+            jnp.maximum(kmax, jnp.max(hi_keys, axis=0)),
+        )
+
+    kmin, kmax = _chunk_scan(rebuild, n_chunks, minmax, (kmin0, kmax0))
+    # bucket geometry in f32 (an int32 span overflows); the <= 2^-24
+    # relative rounding is orders below the bucket width for bins << 2^24
+    kminf = kmin.astype(jnp.float32)
+    span = jnp.maximum(kmax.astype(jnp.float32) - kminf, 1.0)
+    col = jnp.arange(d, dtype=jnp.int32)
+
+    def hist_pass(hist, chunk, _):
+        keys, fin = chunk_keys(chunk)
+        t = (keys.astype(jnp.float32) - kminf[None, :]) / span[None, :]
+        idx = jnp.clip((t * bins).astype(jnp.int32), 0, bins - 1)
+        ones = (
+            fin.astype(jnp.int32)[:, 0]
+            if fin is not None
+            else jnp.ones(keys.shape[0], jnp.int32)
+        )
+        return hist.at[idx, jnp.broadcast_to(col, idx.shape)].add(
+            ones[:, None]
+        )
+
+    hist = _chunk_scan(
+        rebuild, n_chunks, hist_pass, jnp.zeros((bins, d), jnp.int32)
+    )
+    cum = jnp.cumsum(hist, axis=0)  # [bins, d]
+    targets = jnp.reshape(jnp.asarray(ns, jnp.int32), (r, 1))
+    # first bucket whose cumulative count exceeds the rank
+    bucket = jnp.argmax(
+        cum[None, :, :] > targets[:, None, :], axis=1
+    ).astype(jnp.float32)  # [r, d]
+    est = kminf[None, :] + (bucket + 1.0) * (span[None, :] / bins)
+    return jnp.minimum(est.astype(jnp.int32), kmax[None, :])
+
+
+def _stream_trimmed_tail(rebuild, n_chunks: int, lo_k, hi_k, n, b,
+                         degraded: bool):
+    """Final trimmed-mean pass given the kept band's boundary keys [d]:
+    strict-interior sum plus boundary values times their kept multiplicity
+    (the resident :func:`_select_trimmed_mean` rank-run formula), with the
+    denominator taken as the ACTUAL kept count so the same tail serves the
+    exact rung (where it equals k - 2b) and the sketch rung (where the
+    estimated boundaries may keep a slightly different band)."""
+    d = lo_k.shape[0]
+    zero_i = jnp.zeros(d, jnp.int32)
+    init = (jnp.zeros(d, jnp.float32), zero_i, zero_i, zero_i, zero_i)
+
+    def acc(carry, chunk, _):
+        total, lt_lo, le_lo, lt_hi, le_hi = carry
+        w32 = chunk.astype(jnp.float32)
+        keys = pallas_kernels.total_order_keys(w32)
+        live = (
+            _finite_rows(chunk)[:, None]
+            if degraded
+            else jnp.ones(keys.shape, bool)
+        )
+
+        def count(cmp):
+            return jnp.sum(
+                jnp.logical_and(cmp, live), axis=0, dtype=jnp.int32
+            )
+
+        interior = jnp.logical_and(
+            jnp.logical_and(keys > lo_k[None, :], keys < hi_k[None, :]),
+            live,
+        )
+        return (
+            total + jnp.sum(jnp.where(interior, w32, 0.0), axis=0),
+            lt_lo + count(keys < lo_k[None, :]),
+            le_lo + count(keys <= lo_k[None, :]),
+            lt_hi + count(keys < hi_k[None, :]),
+            le_hi + count(keys <= hi_k[None, :]),
+        )
+
+    total, lt_lo, le_lo, lt_hi, le_hi = _chunk_scan(
+        rebuild, n_chunks, acc, init
+    )
+    last = n - b - 1  # highest kept rank
+
+    def kept_copies(n_lt, n_le):
+        run = jnp.minimum(n_le - 1, last) - jnp.maximum(n_lt, b) + 1
+        return jnp.maximum(run, 0)
+
+    def boundary_sum(boundary, copies):
+        v = pallas_kernels.total_order_vals(boundary)
+        return jnp.where(copies > 0, copies.astype(jnp.float32) * v, 0.0)
+
+    copies_lo = kept_copies(lt_lo, le_lo)
+    copies_hi = jnp.where(lo_k == hi_k, 0, kept_copies(lt_hi, le_hi))
+    interior_cnt = jnp.maximum(lt_hi - le_lo, 0)
+    total = total + boundary_sum(lo_k, copies_lo)
+    total = total + boundary_sum(hi_k, copies_hi)
+    kept = interior_cnt + copies_lo + copies_hi
+    return total / jnp.maximum(kept, 1).astype(jnp.float32)
+
+
+def _stream_quantile_keys(rebuild, n_chunks, d, ns, r, *, quantile,
+                          sketch_bins, degraded):
+    if quantile == "sketch":
+        return _stream_sketch_keys(
+            rebuild, n_chunks, d, ns, r, sketch_bins, degraded
+        )
+    count_le = _stream_count_le(rebuild, n_chunks, degraded)
+    return _stream_bisect_keys(count_le, ns, r, d)
+
+
+def stream_mean(rebuild, *, k, d, n_chunks, degraded=False, sum_all=None,
+                sum_finite=None, n_finite=None, **_):
+    """Streamed :func:`mean`: exact up to chunk-sum reassociation.  The
+    running sums normally arrive precomputed from the trainer's
+    observation pass (0 extra rebuild passes)."""
+    if sum_all is None or sum_finite is None or n_finite is None:
+        sum_all, sum_finite, n_finite = stream_stats(rebuild, n_chunks, d)
+    if degraded:
+        return jnp.where(
+            n_finite > 0,
+            sum_finite / jnp.maximum(n_finite, 1).astype(jnp.float32),
+            jnp.nan,
+        )
+    return sum_all / jnp.float32(k)
+
+
+def stream_gm2(rebuild, *, k, d, n_chunks, guess=None, maxiter=1000,
+               tol=1e-5, degraded=False, sum_all=None, sum_finite=None,
+               n_finite=None, **_):
+    """Streamed :func:`gm2`: each Weiszfeld step's num/den reductions
+    accumulate over one chunk pass with the resident solver's exact
+    DIST_CLAMP / finite-mask / movement-stop semantics."""
+    if guess is None:
+        if sum_finite is None or n_finite is None:
+            _, sum_finite, n_finite = stream_stats(rebuild, n_chunks, d)
+        init_guess = sum_finite / jnp.maximum(n_finite, 1).astype(
+            jnp.float32
+        )
+    else:
+        init_guess = guess.astype(jnp.float32)
+
+    def cond(state):
+        i, _, movement = state
+        return jnp.logical_and(i < maxiter, movement > tol)
+
+    def body(state):
+        i, g, _ = state
+
+        def acc(carry, chunk, _):
+            num, den = carry
+            fin = _finite_rows(chunk)
+            c32 = chunk.astype(jnp.float32)
+            dist = _weiszfeld_dists(c32, g)
+            inv = jnp.where(fin, 1.0 / dist, 0.0)
+            num = num + jnp.sum(
+                jnp.where(fin[:, None], c32 * inv[:, None], 0.0), axis=0
+            )
+            return num, den + jnp.sum(inv)
+
+        num, den = _chunk_scan(
+            rebuild, n_chunks, acc,
+            (jnp.zeros(d, jnp.float32), jnp.float32(0.0)),
+        )
+        g_next = num / den
+        movement = jnp.linalg.norm(g - g_next)
+        return i + 1, g_next, movement
+
+    _, final, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), init_guess, jnp.float32(jnp.inf))
+    )
+    return final
+
+
+def stream_median(rebuild, *, k, d, n_chunks, degraded=False,
+                  n_finite=None, quantile="exact", sketch_bins=512, **_):
+    """Streamed :func:`median` (torch lower-middle semantics): locate the
+    ``(n-1)//2`` rank key by bisection (exact — bit-equal to the resident
+    selection) or sketch, and bit-roundtrip it back to the value."""
+    if degraded:
+        if n_finite is None:
+            _, _, n_finite = stream_stats(rebuild, n_chunks, d)
+        n = n_finite
+    else:
+        n = k
+    rank = jnp.maximum(jnp.asarray(n, jnp.int32) - 1, 0) // 2
+    key = _stream_quantile_keys(
+        rebuild, n_chunks, d, rank[None] if jnp.ndim(rank) == 0 else rank,
+        1, quantile=quantile, sketch_bins=sketch_bins, degraded=degraded,
+    )
+    return pallas_kernels.total_order_vals(key[0])
+
+
+def stream_trimmed_mean(rebuild, *, k, d, n_chunks, trim_ratio=0.1,
+                        beta=None, degraded=False, n_finite=None,
+                        quantile="exact", sketch_bins=512, **_):
+    """Streamed :func:`trimmed_mean`: kept-band boundary ranks by
+    bisection/sketch, then one interior/boundary-multiplicity pass (the
+    resident rank-run tie handling).  Degraded rounds adapt the trim
+    budget to the finite-row count exactly like the resident sort path."""
+    if degraded:
+        if n_finite is None:
+            _, _, n_finite = stream_stats(rebuild, n_chunks, d)
+        n = jnp.asarray(n_finite, jnp.int32)
+        if beta is None:
+            b = (n.astype(jnp.float32) * trim_ratio).astype(jnp.int32)
+        else:
+            b = jnp.minimum(int(beta), jnp.maximum(n - 1, 0) // 2)
+    else:
+        n = jnp.int32(k)
+        b = jnp.int32(int(k * trim_ratio) if beta is None else int(beta))
+    ns = jnp.stack([b, jnp.maximum(n - b - 1, 0)])
+    keys = _stream_quantile_keys(
+        rebuild, n_chunks, d, ns, 2,
+        quantile=quantile, sketch_bins=sketch_bins, degraded=degraded,
+    )
+    out = _stream_trimmed_tail(
+        rebuild, n_chunks, keys[0], keys[1], n, b, degraded
+    )
+    if degraded:
+        return jnp.where(n > 0, out, jnp.nan)
+    return out
+
+
+_STREAM_FNS = {
+    "mean": stream_mean,
+    "median": stream_median,
+    "trimmed_mean": stream_trimmed_mean,
+    "gm2": stream_gm2,
+}
+
+
+def stream_aggregate(name: str, rebuild, **kw):
+    """Dispatch to the streamed realization of a ``streamable`` aggregator.
+
+    ``rebuild(c_idx) -> [cohort, d]`` must be pure in the cohort index:
+    multi-pass algorithms call it once per pass and rely on every pass
+    seeing identical chunks.  Keyword surface mirrors the resident
+    aggregators (guess/maxiter/tol/trim/degraded) plus the streamed-only
+    knobs (n_chunks, quantile, sketch_bins, and the optional precomputed
+    observation-pass stats sum_all/sum_finite/n_finite)."""
+    fn = AGGREGATORS.get(name)
+    for stream_name, stream_fn in _STREAM_FNS.items():
+        if fn is AGGREGATORS.get(stream_name):
+            return stream_fn(rebuild, **kw)
+    raise ValueError(
+        f"aggregator {name!r} has no streaming realization "
+        f"(streamable: {sorted(_STREAM_FNS)})"
+    )
 
 
 def resolve(name: str):

@@ -241,6 +241,22 @@ ATTACKS.register("minsum")(
 )
 
 
+# message attacks whose Byzantine rows depend only on those rows (and the
+# key): they apply chunk-by-chunk under cohort streaming.  The omniscient
+# attacks (weightflip/alie/ipm/minmax/minsum) read honest-row statistics
+# off the resident stack and cannot stream.
+_ROW_LOCAL_MESSAGES = frozenset({"signflip", "gaussian"})
+
+
+def streamable(spec: AttackSpec) -> bool:
+    """Whether the attack can run on per-cohort chunks (streamed rounds):
+    data-level / grad-scale attacks act inside the client step and always
+    stream; message attacks stream only when row-local."""
+    if spec.message_fn is None:
+        return True
+    return spec.name.partition("@")[0] in _ROW_LOCAL_MESSAGES
+
+
 def resolve(name: Optional[str]) -> Optional[AttackSpec]:
     """Look up an attack by CLI name; None means no attack (all honest).
 

@@ -39,6 +39,21 @@ import jax.numpy as jnp
 HSQ_FLOOR = 1e-6
 
 
+def cohort_key(key: jax.Array, cohort_idx) -> jax.Array:
+    """Per-cohort sub-key for streamed rounds: ``fold_in(key, cohort_idx)``.
+
+    Cohort streaming (``FedConfig.cohort_size > 0``) cannot draw one [K, d]
+    channel/fault/attack-noise realization up front — each chunk draws its
+    own from the ROUND key folded with the cohort index.  The round-level
+    ``jax.random.split`` layout is untouched (same split count and order as
+    the resident path), so the stream of round keys is invariant; only the
+    per-client realizations differ, which the round records already own up
+    to (they are a fresh draw every round either way).  One helper so the
+    trainer, fault layer and tests all derive chunk keys identically.
+    """
+    return jax.random.fold_in(key, cohort_idx)
+
+
 def rayleigh_fade(key: jax.Array, k: int):
     """Per-client complex fade components h_r, h_i ~ N(0, 1/2), shape [K]."""
     kr, ki = jax.random.split(key)

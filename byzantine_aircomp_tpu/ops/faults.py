@@ -212,7 +212,8 @@ def apply_dropout(
 
 
 def apply_transmission(
-    spec: FaultSpec, key: jax.Array, w_stack: jnp.ndarray, ge_bad
+    spec: FaultSpec, key: jax.Array, w_stack: jnp.ndarray, ge_bad,
+    row_offset=0,
 ):
     """Post-attack transmission impairments: payload corruption, then the
     channel (CSI error + deep-fade erasure).
@@ -220,6 +221,13 @@ def apply_transmission(
     Returns ``(w_stack, new_ge_bad, n_erased, n_corrupt)``.  Corruption hits
     the FIRST ``corrupt_size`` rows (the honest side — a crashed sender is a
     fault, not an attacker); channel impairments hit every row.
+
+    ``row_offset`` is the global client index of row 0 — nonzero only under
+    cohort streaming, where ``w_stack`` is one [cohort, d] chunk and
+    corruption eligibility must be judged against GLOBAL client positions
+    (the trainer passes the matching ``ge_bad`` slice and a per-cohort
+    ``fold_in`` key; everything else here is already row-local).  May be a
+    traced scalar.
     """
     k = w_stack.shape[0]
     k_corrupt, k_fade, k_csi, k_ge = jax.random.split(key, 4)
@@ -227,7 +235,7 @@ def apply_transmission(
     n_erased = jnp.float32(0.0)
 
     if spec.corrupt_prob > 0.0:
-        eligible = jnp.arange(k) < spec.corrupt_size
+        eligible = row_offset + jnp.arange(k) < spec.corrupt_size
         crashed = jnp.logical_and(
             eligible, jax.random.bernoulli(k_corrupt, spec.corrupt_prob, (k,))
         )

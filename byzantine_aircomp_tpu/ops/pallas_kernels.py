@@ -49,6 +49,7 @@ CPU (tests / no-TPU) runs use ``interpret=True`` automatically.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -281,14 +282,34 @@ def total_order_vals(keys: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.bitcast_convert_type(i, jnp.float32)
 
 
+def sort_fused_reason(k: int, channel: bool = False) -> Optional[str]:
+    """Why a selection kernel CANNOT take the fused pallas path — None when
+    it can.  The byte math is the :func:`supports_sort_fused` predicate
+    spelled out: a rejection used to be a silent ``False`` that surfaced
+    only as an unexplained ``select`` row in the fallback matrix; now the
+    dispatch sites (ops/aggregators.py, benchmarks/agg_kernels.py) log the
+    reason string so a fallback is attributable from the run log alone."""
+    kp = _round_up(k, 8)
+    n = SELECT_STACK_ARRAYS + (SELECT_CHANNEL_ARRAYS if channel else 0)
+    need = n * kp * LANE * 4
+    if need > VMEM_BLOCK_BUDGET:
+        arrays = "values+keys+mask" + ("+noise_r+noise_i" if channel else "")
+        return (
+            f"K={k} (padded {kp}) needs {need} B of VMEM for the "
+            f"[{kp}, {LANE}] {arrays} working set "
+            f"({n} arrays), over the {VMEM_BLOCK_BUDGET} B block budget"
+        )
+    return None
+
+
 def supports_sort_fused(k: int, channel: bool = False) -> bool:
     """Whether a selection kernel can hold a full-K [Kp, 128] working set
     (values + keys + mask, + noise tiles when the channel is fused) in the
     VMEM block budget.  K-bound, unlike :func:`supports_fused` (d-bound):
-    the selection grid runs over d, so d never limits residency."""
-    kp = _round_up(k, 8)
-    n = SELECT_STACK_ARRAYS + (SELECT_CHANNEL_ARRAYS if channel else 0)
-    return n * kp * LANE * 4 <= VMEM_BLOCK_BUDGET
+    the selection grid runs over d, so d never limits residency.
+    :func:`sort_fused_reason` is the same predicate with the rejection
+    spelled out for the fallback-matrix log."""
+    return sort_fused_reason(k, channel) is None
 
 
 def _select_kernel(k_actual, kp, n_low, n_high, want_mean, channel, *refs):

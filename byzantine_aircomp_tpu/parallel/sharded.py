@@ -61,6 +61,14 @@ class ShardedFedTrainer(FedTrainer):
                     f"buckets, not divisible by the "
                     f"'{mesh_lib.CLIENT_AXIS}' mesh axis ({n_clients_axis})"
                 )
+        if cfg.cohort_size > 0 and cfg.cohort_size % n_clients_axis:
+            # streamed rounds hand [cohort, d] chunks to the shard-mapped
+            # client step, so the chunk (not K) is what the axis must divide
+            raise ValueError(
+                f"cohort_size {cfg.cohort_size} is not divisible by the "
+                f"'{mesh_lib.CLIENT_AXIS}' mesh axis ({n_clients_axis}); "
+                f"streamed chunks are sharded over that axis"
+            )
         super().__init__(cfg, dataset=dataset)
 
         # GSPMD has no partitioning rule for pallas_call: with the [K, d]

@@ -1,0 +1,365 @@
+"""Cohort-streamed rounds (``--cohort-size``): parity, bounds, contracts.
+
+The acceptance bar (ISSUE 6): at resident-feasible K the streamed path
+must MATCH the resident one — exactly for mean (up to chunk-sum
+reassociation) and the selection family (the key bisection locates the
+same order-statistic keys the resident sort does), within the documented
+one-bucket bound for the quantile sketch — including under row-local
+attacks and fault injection.  ``--cohort-size 0`` keeps the resident
+code path verbatim (config_hash / run_title continuity is tested here
+too).  The ``lowering`` test doubles as part of the CI retrace gate
+(``-k "retrace or lowering"``).
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu.data import datasets as data_lib
+from byzantine_aircomp_tpu.fed.config import FedConfig
+from byzantine_aircomp_tpu.fed.train import FedTrainer
+from byzantine_aircomp_tpu.obs import hbm as hbm_lib
+from byzantine_aircomp_tpu.ops import aggregators as agg_lib
+from byzantine_aircomp_tpu.ops import pallas_kernels as pk
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------- unit-level parity
+
+
+def _chunked(stack, cohort):
+    """The reference rebuild closure: pure dynamic slices of a resident
+    stack — what the trainer's rebuild is equivalent to, minus the
+    recompute."""
+    def rebuild(c_idx):
+        return jax.lax.dynamic_slice_in_dim(
+            stack, c_idx * cohort, cohort, axis=0
+        )
+    return rebuild, stack.shape[0] // cohort
+
+
+def _rand_stack(key, k=24, d=33):
+    return jax.random.normal(jax.random.PRNGKey(key), (k, d), jnp.float32)
+
+
+def test_stream_matches_resident_unit():
+    stack = _rand_stack(0)
+    k, d = stack.shape
+    rebuild, p = _chunked(stack, 6)
+    kw = dict(k=k, d=d, n_chunks=p)
+
+    # selection is EXACT: the bisection finds the same total-order keys
+    np.testing.assert_array_equal(
+        np.asarray(agg_lib.stream_median(rebuild, **kw)),
+        np.asarray(agg_lib.median(stack)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(agg_lib.stream_mean(rebuild, **kw)),
+        np.asarray(agg_lib.mean(stack)),
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(agg_lib.stream_trimmed_mean(rebuild, trim_ratio=0.1, **kw)),
+        np.asarray(agg_lib.trimmed_mean(stack, trim_ratio=0.1)),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(agg_lib.stream_gm2(rebuild, maxiter=100, tol=1e-5, **kw)),
+        np.asarray(agg_lib.gm2(stack, maxiter=100, tol=1e-5)),
+        atol=1e-5,
+    )
+
+
+def test_stream_selection_exact_with_ties():
+    # repeated values: the boundary-multiplicity (rank-run) tail must
+    # weight tied boundary keys exactly like the resident sort band
+    stack = jnp.round(_rand_stack(1) * 2.0) / 2.0  # heavy ties
+    k, d = stack.shape
+    rebuild, p = _chunked(stack, 4)
+    kw = dict(k=k, d=d, n_chunks=p)
+    np.testing.assert_array_equal(
+        np.asarray(agg_lib.stream_median(rebuild, **kw)),
+        np.asarray(agg_lib.median(stack)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(agg_lib.stream_trimmed_mean(rebuild, trim_ratio=0.2, **kw)),
+        np.asarray(agg_lib.trimmed_mean(stack, trim_ratio=0.2)),
+        atol=1e-5,
+    )
+
+
+def test_stream_degraded_with_nan_rows():
+    stack = _rand_stack(2)
+    stack = stack.at[3].set(jnp.nan).at[17].set(jnp.inf)
+    k, d = stack.shape
+    rebuild, p = _chunked(stack, 6)
+    kw = dict(k=k, d=d, n_chunks=p, degraded=True)
+    np.testing.assert_array_equal(
+        np.asarray(agg_lib.stream_median(rebuild, **kw)),
+        np.asarray(agg_lib.median(stack, degraded=True)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(agg_lib.stream_trimmed_mean(rebuild, trim_ratio=0.1, **kw)),
+        np.asarray(agg_lib.trimmed_mean(stack, trim_ratio=0.1, degraded=True)),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(agg_lib.stream_mean(rebuild, **kw)),
+        np.asarray(agg_lib.mean(stack, degraded=True)),
+        atol=1e-6,
+    )
+
+
+def test_stream_sketch_within_one_bucket_bound():
+    # the sketch estimate is the bucket UPPER edge: >= the true order
+    # statistic's key, by at most one bucket width of the key span
+    bins = 4096
+    stack = _rand_stack(3)
+    k, d = stack.shape
+    rebuild, p = _chunked(stack, 6)
+    est = agg_lib.stream_median(
+        rebuild, k=k, d=d, n_chunks=p, quantile="sketch", sketch_bins=bins
+    )
+    true = agg_lib.median(stack)
+    keys = np.asarray(pk.total_order_keys(stack), np.int64)
+    k_est = np.asarray(pk.total_order_keys(est[None, :])[0], np.int64)
+    k_true = np.asarray(pk.total_order_keys(true[None, :])[0], np.int64)
+    span = keys.max(axis=0) - keys.min(axis=0)
+    assert (k_est >= k_true).all()
+    assert (k_est - k_true <= span / bins + 2).all()
+
+
+def test_stream_aggregate_rejects_unstreamable():
+    stack = _rand_stack(4)
+    rebuild, p = _chunked(stack, 6)
+    with pytest.raises(ValueError, match="no streaming realization"):
+        agg_lib.stream_aggregate(
+            "krum", rebuild, k=stack.shape[0], d=stack.shape[1], n_chunks=p
+        )
+    assert agg_lib.streamable("mean")
+    assert agg_lib.streamable("median")
+    assert not agg_lib.streamable("krum")
+
+
+# ------------------------------------------ fused-epilogue rejection
+
+
+def test_sort_fused_reason_matches_support():
+    for k in (8, 256, 2048, 100_000):
+        for channel in (False, True):
+            reason = pk.sort_fused_reason(k, channel)
+            assert (reason is None) == pk.supports_sort_fused(k, channel)
+
+
+def test_sort_fused_reason_names_the_byte_math():
+    reason = pk.sort_fused_reason(100_000, channel=True)
+    assert reason is not None
+    assert "K=100000" in reason
+    assert "noise_r" in reason  # channel arrays spelled out
+    assert str(pk.VMEM_BLOCK_BUDGET) in reason
+    assert pk.sort_fused_reason(8) is None
+
+
+# ------------------------------------------------ trainer-level parity
+
+
+def _ds():
+    return data_lib.load("mnist", synthetic_train=600, synthetic_val=200)
+
+
+def _cfg(**kw):
+    base = dict(
+        honest_size=8, byz_size=0, rounds=1, display_interval=2,
+        batch_size=16, agg="median", eval_train=False, agg_maxiter=50,
+        agg_tol=1e-5,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _final_params(cfg, ds):
+    tr = FedTrainer(cfg, dataset=ds)
+    tr.run_rounds(0, cfg.rounds)
+    return np.asarray(tr.flat_params)
+
+
+def test_streamed_matches_resident_trainer():
+    ds = _ds()
+    for agg in ("mean", "median", "trimmed_mean", "gm2"):
+        resident = _final_params(_cfg(agg=agg), ds)
+        streamed = _final_params(_cfg(agg=agg, cohort_size=4), ds)
+        if agg == "median":
+            # batch draw + selection are exact: bit-identical rounds
+            np.testing.assert_array_equal(streamed, resident, err_msg=agg)
+        else:
+            np.testing.assert_allclose(
+                streamed, resident, atol=1e-5, err_msg=agg
+            )
+
+
+def test_streamed_attack_parity():
+    ds = _ds()
+    for attack in ("signflip", "classflip"):
+        kw = dict(byz_size=4, attack=attack, agg="median")
+        resident = _final_params(_cfg(**kw), ds)
+        streamed = _final_params(_cfg(cohort_size=4, **kw), ds)
+        np.testing.assert_array_equal(streamed, resident, err_msg=attack)
+
+
+def test_streamed_fault_round_runs_finite():
+    ds = _ds()
+    p = _final_params(
+        _cfg(agg="trimmed_mean", cohort_size=4, fault="deep_fade"), ds
+    )
+    assert np.isfinite(p).all()
+
+
+def test_streamed_adaptive_defense_runs():
+    ds = _ds()
+    p = _final_params(
+        _cfg(
+            agg="mean", cohort_size=4, defense="adaptive",
+            defense_ladder="mean,trimmed_mean,median",
+        ),
+        ds,
+    )
+    assert np.isfinite(p).all()
+
+
+# ----------------------------------------- config continuity + errors
+
+
+def test_cohort_zero_title_and_hash_continuity():
+    from byzantine_aircomp_tpu.fed import harness
+
+    off = _cfg()
+    on = _cfg(cohort_size=4)
+    assert "cohort" not in harness.run_title(off)
+    assert "_cohort4" in harness.run_title(on)
+    assert harness.config_hash(off) != harness.config_hash(on)
+
+
+def test_cohort_validation_errors():
+    def invalid(match, **kw):
+        with pytest.raises(AssertionError, match=match):
+            _cfg(**kw).validate()
+
+    invalid("must divide", cohort_size=3)  # 3 does not divide honest_size=8
+    invalid("no streaming", agg="krum", cohort_size=4)
+    invalid("omniscient", byz_size=4, attack="alie", cohort_size=4)
+    invalid("bucketing", cohort_size=4, bucket_size=2)
+    invalid("full participation", cohort_size=4, participation=0.5)
+    invalid("require --cohort-size", cohort_quantile="sketch")
+    _cfg(cohort_size=4).validate()  # the happy path really is valid
+
+
+# --------------------------------------------------- retrace / memory
+
+
+def test_streamed_round_single_lowering(tmp_path, monkeypatch):
+    """CI retrace-gate member: the cohort scan must not add lowerings —
+    the streamed round fn traces exactly once."""
+    import byzantine_aircomp_tpu.data.datasets as dl
+    from byzantine_aircomp_tpu.fed import harness
+    from byzantine_aircomp_tpu.obs import events_path
+
+    orig = dl.load
+    monkeypatch.setattr(
+        dl, "load",
+        lambda name, **kw: orig(name, synthetic_train=600, synthetic_val=200),
+    )
+    cfg = FedConfig(
+        honest_size=6, byz_size=0, rounds=3, display_interval=2,
+        batch_size=16, agg="median", eval_train=False, cohort_size=3,
+        obs_dir=str(tmp_path / "obs"),
+    )
+    harness.run(cfg, record_in_file=False)
+    path = events_path(str(tmp_path / "obs"), harness.ckpt_title(cfg))
+    events = [json.loads(l) for l in open(path)]
+    (ret,) = [e for e in events if e["kind"] == "retrace"]
+    assert ret["counts"]["round_fn"] == 1 and ret["steady_state_ok"]
+    # the harness swapped its peak model to the streamed formula
+    (end,) = [e for e in events if e["kind"] == "run_end"]
+    assert end["memory"]["hbm_model"] == "streamed"
+
+
+def test_streamed_peak_model_scales_with_cohort_not_k():
+    d = 7850
+    small = hbm_lib.streamed_peak_bytes(1_000, d, 100)
+    huge = hbm_lib.streamed_peak_bytes(100_000, d, 100)
+    resident = hbm_lib.modeled_peak_bytes(100_000, d)
+    # K enters only through O(K) per-client state (0 here): same peak
+    assert small == huge
+    assert huge < resident / 100
+    # per-client state adds exactly K bytes per unit
+    assert (
+        hbm_lib.streamed_peak_bytes(100_000, d, 100, state_bytes_per_client=13)
+        == huge + 13 * 100_000
+    )
+
+
+# ------------------------------------------------------ bench surface
+
+
+def _import_bench():
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    import bench
+
+    return bench
+
+
+def test_bench_probe_retry_records_attempts(monkeypatch):
+    bench = _import_bench()
+    import byzantine_aircomp_tpu.utils.env as env_lib
+
+    monkeypatch.setenv("BENCH_PROBE_RETRIES", "2")
+    monkeypatch.setenv("BENCH_PROBE_BACKOFF_SECS", "0")
+    monkeypatch.setattr(bench, "_probe_backend", lambda t: None)
+    monkeypatch.setattr(env_lib, "diagnose_relay", lambda *a, **k: "dead")
+    info, diags = bench._probe_backend_with_retry(1.0)
+    assert info is None
+    assert diags == [
+        "attempt 1: relay dead",
+        "attempt 2: relay dead",
+        "attempt 3: relay dead",
+    ]
+    # success short-circuits with no diagnostics
+    monkeypatch.setattr(
+        bench, "_probe_backend", lambda t: {"backend": "tpu", "n": 8}
+    )
+    info, diags = bench._probe_backend_with_retry(1.0)
+    assert info == {"backend": "tpu", "n": 8} and diags == []
+
+
+def test_bench_ledger_carries_peak_bytes(tmp_path, monkeypatch, capsys):
+    bench = _import_bench()
+
+    ledger = str(tmp_path / "led.jsonl")
+    monkeypatch.setenv("BENCH_LEDGER", ledger)
+    row = bench.make_bench_row(
+        1.5, platform="cpu", timed_rounds=2,
+        params={
+            "k": 64, "b": 0, "agg": "median", "attack": None,
+            "dataset": "mnist", "model": "MLP", "metric": "stream_ksweep",
+        },
+    )
+    row["cohort_size"] = 8
+    row["peak_measured_bytes"] = 123
+    row["peak_source"] = "host_rss"
+    row["peak_streamed_modeled_bytes"] = 456
+    row["peak_resident_modeled_bytes"] = 789
+    bench.emit_row(row)
+    capsys.readouterr()
+    (led_row,) = [json.loads(l) for l in open(ledger)]
+    assert led_row["metric"] == "stream_ksweep"
+    assert led_row["peak_streamed_modeled_bytes"] == 456
+    assert led_row["peak_resident_modeled_bytes"] == 789
+    assert led_row["peak_source"] == "host_rss"
+    assert "k=64" in led_row["key"]

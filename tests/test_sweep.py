@@ -165,13 +165,18 @@ def test_sweep_forwards_every_shared_knob():
         "defense_up": 2,
         "defense_down": 10,
         "defense_min_flagged": 2,
+        "cohort_size": 4,
+        "cohort_quantile": "sketch",
+        "cohort_sketch_bins": 256,
     }
     # the fault knobs require --fault and full participation
     # (config.validate), so they ride a second, separate sweep cell;
-    # same for the defense knobs (--defense + full participation)
+    # same for the defense knobs (--defense + full participation) and the
+    # cohort knobs (--cohort-size needs full participation and no bucketing)
     fault_dests = {"fault", "dropout_prob", "fade_floor", "csi_std",
                    "corrupt_prob", "corrupt_mode", "corrupt_size"}
     defense_dests = {d for d in samples if d.startswith("defense")}
+    cohort_dests = {d for d in samples if d.startswith("cohort")}
     probe = argparse.ArgumentParser()
     add_knob_flags(probe)
     flag_of = {
@@ -189,9 +194,10 @@ def test_sweep_forwards_every_shared_knob():
             "--rounds", "1", "--interval", "2", "--batch-size", "8"]
     orig = sweep_mod.run_sweep
     groups = (
-        set(flag_of) - fault_dests - defense_dests,
+        set(flag_of) - fault_dests - defense_dests - cohort_dests,
         fault_dests,
         defense_dests,
+        cohort_dests,
     )
     for group in groups:
         argv = list(base)
