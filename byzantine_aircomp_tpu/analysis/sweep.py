@@ -222,6 +222,16 @@ def main(argv=None) -> None:
         cohort_size=args.cohort_size,
         cohort_quantile=args.cohort_quantile,
         cohort_sketch_bins=args.cohort_sketch_bins,
+        service=args.service,
+        population=args.population,
+        churn_arrival=args.churn_arrival,
+        churn_departure=args.churn_departure,
+        straggler_prob=args.straggler_prob,
+        rollback=args.rollback,
+        rollback_loss_factor=args.rollback_loss_factor,
+        rollback_cusum=args.rollback_cusum,
+        rollback_widen=args.rollback_widen,
+        rollback_max=args.rollback_max,
     )
     # stdout keeps one JSON object per completed cell (the shape scripts
     # already parse — schema stamps v/kind/ts are additive); --obs-dir tees

@@ -132,6 +132,41 @@ def add_knob_flags(p) -> None:
                    help="consecutive clean iterations per de-escalation")
     p.add_argument("--defense-min-flagged", type=int, default=1,
                    help="flagged clients that make an iteration suspicious")
+    # service-round surface (fed/train.py); knob flags require --service on
+    p.add_argument("--service", choices=["off", "on"], default="off",
+                   help="always-on service rounds: draw each round's K "
+                        "participants from a registered --population with "
+                        "churn/deadline semantics and warm rollback (off "
+                        "is bit-identical to a run without the feature)")
+    p.add_argument("--population", type=int, default=0,
+                   help="registered client population N_pop >> K; must be "
+                        "a positive multiple of K (requires --service on)")
+    p.add_argument("--churn-arrival", type=float, default=0.02,
+                   help="per-iteration probability an offline population "
+                        "client comes back online (Markov churn)")
+    p.add_argument("--churn-departure", type=float, default=0.01,
+                   help="per-iteration probability an online population "
+                        "client goes offline (Markov churn)")
+    p.add_argument("--straggler-prob", type=float, default=0.0,
+                   help="per-iteration probability a drawn participant "
+                        "misses the round deadline (its row is erased and "
+                        "aggregation degrades to the effective K)")
+    p.add_argument("--rollback", choices=["off", "on"], default="on",
+                   help="warm rollback: on divergence restore the last "
+                        "good round state and resume with a widened trim "
+                        "fraction under a re-salted key stream")
+    p.add_argument("--rollback-loss-factor", type=float, default=3.0,
+                   help="divergence guard: trip when val loss exceeds this "
+                        "multiple of the recent median")
+    p.add_argument("--rollback-cusum", type=float, default=0.0,
+                   help="divergence guard: trip when the defense CUSUM "
+                        "maximum reaches this (0 = off; requires "
+                        "--defense)")
+    p.add_argument("--rollback-widen", type=float, default=1.5,
+                   help="trim-fraction multiplier applied on each rollback")
+    p.add_argument("--rollback-max", type=int, default=3,
+                   help="rollback budget per run (after it is spent the "
+                        "guard reports but no longer restores)")
 
 
 ARG_TO_FIELD = {
@@ -179,6 +214,16 @@ ARG_TO_FIELD = {
     "defense_up": ("defense_up", None),
     "defense_down": ("defense_down", None),
     "defense_min_flagged": ("defense_min_flagged", None),
+    "service": ("service", None),
+    "population": ("population", None),
+    "churn_arrival": ("churn_arrival", None),
+    "churn_departure": ("churn_departure", None),
+    "straggler_prob": ("straggler_prob", None),
+    "rollback": ("rollback", None),
+    "rollback_loss_factor": ("rollback_loss_factor", None),
+    "rollback_cusum": ("rollback_cusum", None),
+    "rollback_widen": ("rollback_widen", None),
+    "rollback_max": ("rollback_max", None),
     "profile_dir": ("profile_dir", None),
     "profile_rounds": ("profile_rounds", None),
     "hbm_warn_factor": ("hbm_warn_factor", None),

@@ -129,6 +129,7 @@ def detector_update(
     score: jnp.ndarray,
     finite: jnp.ndarray,
     p: DetectorParams,
+    first=None,
 ):
     """One detector step: robust EMA baselines + one-sided CUSUM.
 
@@ -145,17 +146,25 @@ def detector_update(
     counter and making de-escalation unreachable.  Non-finite rows hold
     their state and never flag (mirrors the NumPy oracle in
     tests/test_defense.py line for line).
+
+    ``first`` (optional [rows] bool) overrides the seeding condition:
+    under service subsampling the detector is population-keyed and a
+    client's FIRST observation can land at any step, so the trainer
+    passes its own never-updated marker (``dev == 0``) instead of the
+    default full-participation ``step == 0``.
     """
     step, ema, dev, cusum = det
     warm = step >= p.warmup
+    if first is None:
+        first = step == 0
 
     sigma = dev + p.eps
     resid = score - ema
     z = resid / sigma
     clipped = jnp.clip(resid, -p.clip * sigma, p.clip * sigma)
-    ema_new = jnp.where(step == 0, score, ema + p.alpha * clipped)
+    ema_new = jnp.where(first, score, ema + p.alpha * clipped)
     dev_new = jnp.where(
-        step == 0,
+        first,
         jnp.abs(score) + p.eps,
         (1.0 - p.alpha) * dev + p.alpha * jnp.abs(clipped),
     )

@@ -28,8 +28,12 @@ def checkpoint_file(ckpt_dir: str, title: str) -> str:
 def save(
     ckpt_dir: str, title: str, round_idx: int, flat_params, opt_leaves=()
 ) -> str:
-    """Write params (+ optional server-optimizer state leaves, in pytree-leaf
-    order) atomically."""
+    """Write params (+ optional extra state leaves, in pytree-leaf order)
+    atomically.  ``opt_leaves`` carries everything beyond the params that a
+    resume needs — server-optimizer state, fault/defense carries, and under
+    ``--service on`` the population availability, widen scale and rollback
+    epoch (see ``harness._extra_state``); this module stays leaf-order
+    agnostic."""
     path = checkpoint_file(ckpt_dir, title)
     # materialize host copies BEFORE acquiring the fd: a device error here
     # must not leak the tmp file

@@ -226,6 +226,40 @@ class FedConfig:
     cohort_quantile: str = "exact"
     # histogram resolution of the quantile sketch ([bins, d] i32 carry)
     cohort_sketch_bins: int = 512
+    # always-on service rounds: when "on" the round no longer trains a
+    # fixed cohort — a registered POPULATION of ``population`` clients
+    # (split exactly proportionally into honest/Byzantine id blocks, see
+    # population_counts) carries per-client availability state with
+    # Markov churn, and every iteration draws a fresh stratified
+    # subsample of honest_size + byz_size participants in-jit from the
+    # available pool.  Stragglers (straggler_prob) and drawn-but-offline
+    # clients miss the round deadline: their rows close as NaN and every
+    # aggregator degrades through the effective-K machinery the fault
+    # subsystem introduced.  "off" (default) is bit-identical to the
+    # pre-service program: no extra key split, empty carry slot,
+    # unchanged config_hash
+    service: str = "off"
+    # registered population size; a positive multiple of node_size so the
+    # honest/Byzantine split over stable population ids stays exact
+    population: int = 0
+    # Markov churn: per-iteration probability that an offline client
+    # re-registers (arrival) / an online client departs
+    churn_arrival: float = 0.02
+    churn_departure: float = 0.01
+    # per-iteration probability that an arrived participant misses the
+    # round deadline (its row is erased to NaN like a dropout)
+    straggler_prob: float = 0.0
+    # warm rollback: "on" arms the divergence guard (non-finite train/val
+    # loss or variance, a val-loss spike past rollback_loss_factor x the
+    # recent median, or — with a defense running and rollback_cusum > 0 —
+    # a CUSUM peak past rollback_cusum).  A trip restores the last good
+    # in-memory snapshot and resumes with the trim fraction widened by
+    # rollback_widen (at most rollback_max restores per run)
+    rollback: str = "on"
+    rollback_loss_factor: float = 3.0
+    rollback_cusum: float = 0.0
+    rollback_widen: float = 1.5
+    rollback_max: int = 3
 
     def participant_counts(self) -> tuple:
         """(honest, Byzantine) rows per iteration — the single source of
@@ -250,6 +284,16 @@ class FedConfig:
                 int(self.participation * self.byz_size + 1e-9),
             )
         return self.honest_size, self.byz_size
+
+    def population_counts(self) -> tuple:
+        """(honest, Byzantine) population block sizes under --service on.
+
+        Stable population ids [0, pop_h) are honest, [pop_h, population)
+        are Byzantine; validate enforces population % node_size == 0 so
+        the split is exactly proportional and the stratified draw keeps
+        the configured Byzantine fraction over ids, not row indices."""
+        per = self.population // self.node_size
+        return per * self.honest_size, per * self.byz_size
 
     # eval
     eval_batch: int = 2000
@@ -322,6 +366,15 @@ class FedConfig:
     # harness.config_hash also reads this tuple to keep the hash of every
     # cohort-off config identical to pre-streaming builds
     _COHORT_KNOBS = ("cohort_quantile", "cohort_sketch_bins")
+
+    # service knobs that require --service on (fault-knob contract);
+    # harness.config_hash also reads this tuple to keep the hash of every
+    # service-off config identical to pre-service builds
+    _SERVICE_KNOBS = (
+        "population", "churn_arrival", "churn_departure", "straggler_prob",
+        "rollback", "rollback_loss_factor", "rollback_cusum",
+        "rollback_widen", "rollback_max",
+    )
 
     def defense_ladder_names(self) -> tuple:
         """The escalation ladder as a tuple of aggregator names."""
@@ -531,9 +584,10 @@ class FedConfig:
                 self.defense_ladder_names(),
                 self.agg if self.defense == "adaptive" else None,
             )
-        assert self.cohort_size >= 0, (
-            f"cohort_size must be >= 0, got {self.cohort_size}"
-        )
+        if self.cohort_size < 0:
+            raise ValueError(
+                f"cohort_size must be >= 0, got {self.cohort_size}"
+            )
         if self.cohort_size == 0:
             # fault-knob contract: tuning a cohort knob without enabling
             # the streamed path would silently do nothing
@@ -542,82 +596,193 @@ class FedConfig:
                 k for k in self._COHORT_KNOBS
                 if getattr(self, k) != defaults[k]
             )
-            assert not touched, (
-                f"cohort knobs {touched} require --cohort-size > 0 (they "
-                f"configure the streamed quantile rung and would otherwise "
-                f"silently do nothing)"
-            )
+            if touched:
+                raise ValueError(
+                    f"cohort knobs {touched} require --cohort-size > 0 "
+                    f"(they configure the streamed quantile rung and would "
+                    f"otherwise silently do nothing)"
+                )
         else:
-            assert self.cohort_quantile in ("exact", "sketch"), (
-                f"cohort_quantile must be 'exact' or 'sketch', "
-                f"got {self.cohort_quantile!r}"
-            )
-            assert self.cohort_sketch_bins >= 2, (
-                f"cohort_sketch_bins must be >= 2, got "
-                f"{self.cohort_sketch_bins}"
-            )
-            assert self.honest_size % self.cohort_size == 0 and (
-                self.byz_size % self.cohort_size == 0
-            ), (
-                f"cohort_size {self.cohort_size} must divide both "
-                f"honest_size {self.honest_size} and byz_size "
-                f"{self.byz_size}: each streamed chunk must be purely "
-                f"honest or purely Byzantine (honest chunks trace no "
-                f"attack code)"
-            )
-            assert self.participation == 1.0, (
-                "streaming cohorts require full participation: the cohort "
-                "scan walks the full [K] client index space in chunks"
-            )
-            assert self.bucket_size == 1, (
-                "bucketing shuffles rows ACROSS cohorts before "
-                "aggregation, which needs the resident stack; use "
-                "--cohort-size 0 with --bucket-size"
-            )
-            assert self.client_momentum == 0.0, (
-                "client_momentum carries a resident [K, d] state buffer — "
-                "exactly the allocation the streamed path removes"
-            )
-            assert self.stack_dtype == "f32", (
-                "the streamed selection rung bisects f32 total-order keys; "
-                "bf16 chunks are not supported (--cohort-size 0 for bf16)"
-            )
-            assert self.fused_epilogue != "on", (
-                "the fused sort-family epilogue reads the resident [K, d] "
-                "stack in one pass — it cannot apply to a streamed round "
-                "(the cohort scan IS the single pass); leave it 'auto'"
-            )
+            if self.cohort_quantile not in ("exact", "sketch"):
+                raise ValueError(
+                    f"cohort_quantile must be 'exact' or 'sketch', "
+                    f"got {self.cohort_quantile!r}"
+                )
+            if self.cohort_sketch_bins < 2:
+                raise ValueError(
+                    f"cohort_sketch_bins must be >= 2, got "
+                    f"{self.cohort_sketch_bins}"
+                )
+            # under partial participation the streamed round walks the
+            # PARTICIPANT index space (subsample-then-stream): the drawn
+            # part_h + part_b rows are chunked, so the chunking contract
+            # is against the participating counts, not the full K
+            if part_h % self.cohort_size or part_b % self.cohort_size:
+                raise ValueError(
+                    f"cohort_size {self.cohort_size} must divide both the "
+                    f"{part_h} participating honest and {part_b} "
+                    f"participating Byzantine clients (each streamed chunk "
+                    f"must be purely honest or purely Byzantine — honest "
+                    f"chunks trace no attack code); pick a participation "
+                    f"fraction whose stratified counts the cohort divides"
+                )
+            if self.bucket_size != 1:
+                raise ValueError(
+                    "bucketing shuffles rows ACROSS cohorts before "
+                    "aggregation, which needs the resident stack; use "
+                    "--cohort-size 0 with --bucket-size"
+                )
+            if self.client_momentum != 0.0:
+                raise ValueError(
+                    "client_momentum carries a resident [K, d] state "
+                    "buffer — exactly the allocation the streamed path "
+                    "removes"
+                )
+            if self.stack_dtype != "f32":
+                raise ValueError(
+                    "the streamed selection rung bisects f32 total-order "
+                    "keys; bf16 chunks are not supported (--cohort-size 0 "
+                    "for bf16)"
+                )
+            if self.fused_epilogue == "on":
+                raise ValueError(
+                    "the fused sort-family epilogue reads the resident "
+                    "[K, d] stack in one pass — it cannot apply to a "
+                    "streamed round (the cohort scan IS the single pass); "
+                    "leave it 'auto'"
+                )
             from ..ops import aggregators as agg_lib
 
             for rung in {self.agg, *(
                 self.defense_ladder_names()
                 if self.defense == "adaptive" else ()
             )}:
-                assert agg_lib.streamable(rung), (
-                    f"aggregator {rung!r} has no streaming/mergeable "
-                    f"formulation (needs the resident [K, d] stack); "
-                    f"streamable: mean, median, trimmed_mean, gm2"
-                )
+                if not agg_lib.streamable(rung):
+                    raise ValueError(
+                        f"aggregator {rung!r} has no streaming/mergeable "
+                        f"formulation (needs the resident [K, d] stack); "
+                        f"streamable: mean, median, trimmed_mean, gm2"
+                    )
             if self.attack is not None:
                 from ..ops import attacks as attack_lib
 
                 spec = attack_lib.resolve(self.attack)
-                assert attack_lib.streamable(spec), (
-                    f"attack {self.attack!r} is omniscient (reads the "
-                    f"honest rows of the resident stack) and cannot run "
-                    f"under cohort streaming; row-local/data-level "
-                    f"attacks (signflip, gaussian, classflip, dataflip, "
-                    f"gradascent) stream fine"
-                )
+                if not attack_lib.streamable(spec):
+                    raise ValueError(
+                        f"attack {self.attack!r} is omniscient (reads the "
+                        f"honest rows of the resident stack) and cannot "
+                        f"run under cohort streaming; row-local/data-level "
+                        f"attacks (signflip, gaussian, classflip, "
+                        f"dataflip, gradascent) stream fine"
+                    )
             if self.fault is not None:
                 from ..ops import faults as fault_lib
 
                 spec = fault_lib.resolve(self.fault, self.fault_overrides())
-                assert not spec.needs_stale, (
-                    f"fault {self.fault!r} keeps a resident [K, d] "
-                    f"stale-replay buffer (dropout_prob > 0) — exactly "
-                    f"the allocation the streamed path removes; deep_fade/"
-                    f"csi/corrupt stream fine"
+                if spec.needs_stale:
+                    raise ValueError(
+                        f"fault {self.fault!r} keeps a resident [K, d] "
+                        f"stale-replay buffer (dropout_prob > 0) — exactly "
+                        f"the allocation the streamed path removes; "
+                        f"deep_fade/csi/corrupt stream fine"
+                    )
+        if self.service not in ("off", "on"):
+            raise ValueError(
+                f"service must be 'off' or 'on', got {self.service!r}"
+            )
+        if self.service == "off":
+            # fault-knob contract: tuning a service knob without enabling
+            # the service loop would silently do nothing
+            defaults = {f.name: f.default for f in dataclasses.fields(self)}
+            touched = sorted(
+                k for k in self._SERVICE_KNOBS
+                if getattr(self, k) != defaults[k]
+            )
+            if touched:
+                raise ValueError(
+                    f"service knobs {touched} require --service on (they "
+                    f"configure the population/churn/rollback model and "
+                    f"would otherwise silently do nothing)"
+                )
+        else:
+            if self.population < self.node_size or (
+                self.population % self.node_size
+            ):
+                raise ValueError(
+                    f"--service on needs --population set to a positive "
+                    f"multiple of node_size {self.node_size} (got "
+                    f"{self.population}): the honest/Byzantine split over "
+                    f"stable population ids must stay exactly proportional"
+                )
+            if self.participation != 1.0:
+                raise ValueError(
+                    "--service on replaces the legacy --participation "
+                    "draw: the per-iteration subsample IS the "
+                    "participation model (K = node_size rows drawn from "
+                    "the population); leave participation at 1.0"
+                )
+            if self.fault is not None:
+                raise ValueError(
+                    "--service on subsumes fault injection: stragglers "
+                    "and churn ARE the dropout model (deadline "
+                    "semantics), and the fault carry (stale-replay "
+                    "buffer, Gilbert-Elliott state) is [K]-row-indexed, "
+                    "which has no stable meaning under per-iteration "
+                    "subsampling; use --straggler-prob instead"
+                )
+            if self.bucket_size != 1:
+                raise ValueError(
+                    "--service on closes rounds with NaN rows for missed "
+                    "deadlines; bucket means would smear a NaN across "
+                    "every row of its bucket — use --bucket-size 1"
+                )
+            if self.client_momentum != 0.0:
+                raise ValueError(
+                    "client_momentum keeps a [K, d] per-row buffer; under "
+                    "per-iteration subsampling it would need a "
+                    "[population, d] buffer keyed by stable ids — not "
+                    "supported, use server_opt momentum instead"
+                )
+            if not (0.0 <= self.churn_arrival <= 1.0
+                    and 0.0 <= self.churn_departure <= 1.0):
+                raise ValueError(
+                    f"churn rates are per-iteration probabilities in "
+                    f"[0, 1], got arrival={self.churn_arrival}, "
+                    f"departure={self.churn_departure}"
+                )
+            if not 0.0 <= self.straggler_prob < 1.0:
+                raise ValueError(
+                    f"straggler_prob must be in [0, 1) — at 1.0 every "
+                    f"round closes empty — got {self.straggler_prob}"
+                )
+            if self.rollback not in ("off", "on"):
+                raise ValueError(
+                    f"rollback must be 'off' or 'on', got {self.rollback!r}"
+                )
+            if self.rollback_loss_factor <= 1.0:
+                raise ValueError(
+                    f"rollback_loss_factor must be > 1 (a spike factor "
+                    f"over the recent val-loss median), got "
+                    f"{self.rollback_loss_factor}"
+                )
+            if self.rollback_cusum < 0.0:
+                raise ValueError(
+                    f"rollback_cusum must be >= 0 (0 disables the CUSUM "
+                    f"guard), got {self.rollback_cusum}"
+                )
+            if self.rollback_cusum > 0.0 and self.defense == "off":
+                raise ValueError(
+                    "rollback_cusum reads the defense CUSUM state — it "
+                    "requires --defense monitor|adaptive"
+                )
+            if self.rollback_widen < 1.0:
+                raise ValueError(
+                    f"rollback_widen must be >= 1 (the trim fraction only "
+                    f"ever widens on rollback), got {self.rollback_widen}"
+                )
+            if self.rollback_max < 1:
+                raise ValueError(
+                    f"rollback_max must be >= 1, got {self.rollback_max}"
                 )
         return self
 

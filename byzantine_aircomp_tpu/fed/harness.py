@@ -143,6 +143,14 @@ def run_title(cfg: FedConfig) -> str:
         for knob in FedConfig._COHORT_KNOBS:
             if _non_default(cfg, knob):
                 title += f"_{knob.replace('cohort_', '')}{getattr(cfg, knob)}"
+    if cfg.service == "on":
+        # service rounds re-key the participant draw / channel / detector
+        # by population id, so they must never alias a static-K trajectory;
+        # composes with the _cohort suffix above (subsample-then-stream)
+        title += f"_pop{cfg.population}_sub{cfg.node_size}"
+        for knob in FedConfig._SERVICE_KNOBS:
+            if knob != "population" and _non_default(cfg, knob):
+                title += f"_{knob.replace('_', '')}{getattr(cfg, knob)}"
     if _non_default(cfg, "prng_impl"):
         title += f"_prng{cfg.prng_impl}"
     if _non_default(cfg, "stack_dtype"):
@@ -210,6 +218,11 @@ def config_hash(cfg: FedConfig) -> str:
         # streaming fields (validate() pins the cohort knobs to their
         # defaults when cohort_size is 0, so skipping drops nothing)
         skip = skip + ("cohort_size",) + FedConfig._COHORT_KNOBS
+    if cfg.service == "off":
+        # and again for the service-round fields: a service-off config
+        # must hash identically to builds that predate them (validate()
+        # pins every service knob to its default when service is off)
+        skip = skip + ("service",) + FedConfig._SERVICE_KNOBS
     items = sorted(
         (f.name, repr(getattr(cfg, f.name)))
         for f in dataclasses.fields(cfg)
@@ -349,8 +362,10 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
         # server-optimizer state, the client-momentum buffer, the
         # fault-injection carry (stale-update buffer + Gilbert-Elliott
         # channel state), the defense carry (detector baselines + policy
-        # rung/streaks) and the attack-onset iteration counter, as one
-        # pytree so the leaf-count match covers all
+        # rung/streaks), the attack-onset iteration counter, and the
+        # service carry (population availability + widened trim scale)
+        # with the rollback epoch, as one pytree so the leaf-count match
+        # covers all
         def _extra_state(t):
             return (
                 getattr(t, "server_opt_state", ()),
@@ -358,6 +373,11 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
                 getattr(t, "fault_state", ()),
                 getattr(t, "defense_state", ()),
                 getattr(t, "attack_iter", ()),
+                getattr(t, "service_state", ()),
+                (
+                    jnp.int32(getattr(t, "_rollback_epoch", 0))
+                    if cfg.service == "on" else ()
+                ),
             )
 
         checkpoint_fn = lambda r, t: checkpoint.save(
@@ -381,7 +401,7 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
                 if len(extra_leaves) == len(own_leaves) and extra_leaves:
                     (
                         server_state, client_m, fault_state, defense_state,
-                        attack_iter,
+                        attack_iter, service_state, rollback_epoch,
                     ) = jax.tree.unflatten(
                         jax.tree.structure(own_state),
                         [
@@ -398,6 +418,14 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
                         trainer.defense_state = defense_state
                     if not isinstance(attack_iter, tuple):  # scalar when on
                         trainer.attack_iter = attack_iter
+                    if jax.tree.leaves(service_state):
+                        trainer.service_state = service_state
+                    if not isinstance(rollback_epoch, tuple):
+                        # epoch == rollbacks-so-far by construction (the
+                        # trainer bumps them together), so one saved scalar
+                        # restores both the key salt and the budget
+                        trainer._rollback_epoch = int(rollback_epoch)
+                        trainer._rollbacks_done = int(rollback_epoch)
                 elif len(extra_leaves) != len(own_leaves):
                     log(
                         "WARNING: checkpoint extra state "
@@ -409,6 +437,16 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
 
     import jax
 
+    service_fields = {}
+    if cfg.service == "on":
+        service_fields = dict(
+            service=cfg.service,
+            population=cfg.population,
+            churn_arrival=cfg.churn_arrival,
+            churn_departure=cfg.churn_departure,
+            straggler_prob=cfg.straggler_prob,
+            rollback=cfg.rollback,
+        )
     obs.emit(
         "run_start",
         title=run_title(cfg),
@@ -424,6 +462,7 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
         fault=cfg.fault,
         defense=cfg.defense,
         seed=cfg.seed,
+        **service_fields,
         # the same static accounting benchmarks/agg_kernels.py reports, so
         # the trainer and the microbench can never disagree on HBM math
         hbm=hbm_lib.aggregator_hbm_model(
@@ -498,6 +537,15 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
                 state_pc += 3 * 4  # detector ema/dev/cusum [K] f32
             if cfg.fault is not None:
                 state_pc += 1  # Gilbert-Elliott bad-state bools [K]
+            if cfg.service == "on":
+                # population-resident rows, expressed per participant:
+                # avail bools over N_pop, and the detector rows grow from
+                # [K] to [population] (the 12 bytes counted above cover
+                # one of the `per` population clients per slot)
+                per = cfg.population // cfg.node_size
+                state_pc += per  # avail [population] bool
+                if cfg.defense != "off":
+                    state_pc += (per - 1) * 3 * 4
             modeled = hbm_lib.streamed_peak_bytes(
                 cfg.node_size, trainer.dim, cfg.cohort_size,
                 data_bytes=data_bytes,
@@ -579,6 +627,13 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
         record["defenseLadder"] = list(cfg.defense_ladder_names())
         for path_key in defense_events.PATH_KEYS.values():
             record[path_key] = paths[path_key]
+    if cfg.service == "on":
+        record["service"] = cfg.service
+        record["population"] = cfg.population
+        record["serviceAvailPath"] = paths["serviceAvailPath"]
+        record["serviceAbsentPath"] = paths["serviceAbsentPath"]
+        record["serviceLatePath"] = paths["serviceLatePath"]
+        record["effectiveKPath"] = paths["effectiveKPath"]
     if record_in_file:
         io_lib.atomic_pickle(path, record)
     return record

@@ -30,6 +30,7 @@ Semantics mirrored exactly (see SURVEY.md §3.2):
 
 from __future__ import annotations
 
+import math
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -218,6 +219,7 @@ class FedTrainer:
             cfg.fused_epilogue == "auto"
             and self._agg_impl == "pallas"
             and self.fault is None
+            and cfg.service == "off"
         )
         if self.defense is not None and self.defense.mode == "adaptive":
             # the deferred-OMA read belongs to exactly ONE statically-known
@@ -261,11 +263,49 @@ class FedTrainer:
         # executed round ((), i.e. absent, when faults are off)
         self.last_fault_metrics = ()
 
+        # service-round state (cfg.service doc): per-population-id
+        # availability bools plus the rollback trim-widening scalar,
+        # carried across rounds like the fault state; () when off so the
+        # default program's carry slot is cost-free.  The pop->data-shard
+        # residue map gives every stable population id a data shard (the
+        # population oversubscribes the node_size shards round-robin
+        # within each stratum, so honest ids never read Byzantine shards)
+        if cfg.service == "on":
+            self._pop_h, self._pop_b = cfg.population_counts()
+            pop_shard = np.empty(cfg.population, np.int32)
+            pop_shard[: self._pop_h] = np.arange(self._pop_h) % cfg.honest_size
+            if cfg.byz_size:
+                pop_shard[self._pop_h :] = cfg.honest_size + (
+                    np.arange(self._pop_b) % cfg.byz_size
+                )
+            self._pop_shard = jnp.asarray(pop_shard)
+            self.service_state = (
+                jnp.ones((cfg.population,), bool),  # everyone starts online
+                jnp.float32(1.0),                   # rollback trim widening
+            )
+        else:
+            self.service_state = ()
+        # per-round [available, absent, late, min_effective_k] from the
+        # last executed round (() when the service loop is off)
+        self.last_service_metrics = ()
+        # warm-rollback bookkeeping (train()): the epoch salts the round
+        # keys AFTER a restore so the replayed rounds draw fresh batches/
+        # noise (0 = never rolled back = the pristine key stream)
+        self._rollback_epoch = 0
+        self._rollbacks_done = 0
+
         # defense carry (defense/__init__.init_state): detector EMA/CUSUM
         # baselines + policy rung/streaks, [K]-indexed like the fault state
         # and carried the same way; () when the defense is off.  The sharded
         # trainer re-lays the [K] leaves out (replicated) afterwards.
-        self.defense_state = defense_lib.init_state(self.defense, cfg.node_size)
+        # Under --service on the detector rows are keyed by STABLE
+        # population ids (scores survive non-participation), so the state
+        # is [population]-sized and the iteration gathers/scatters the
+        # drawn rows.
+        self.defense_state = defense_lib.init_state(
+            self.defense,
+            cfg.population if cfg.service == "on" else cfg.node_size,
+        )
         # per-round [rung, flagged, suspicious, score, cusum, transitions]
         # from the last executed round (() when the defense is off)
         self.last_defense_metrics = ()
@@ -291,17 +331,17 @@ class FedTrainer:
         # bookkeeping — the traced program, RNG stream and outputs are
         # bit-identical; steady-state enforcement is the harness's/CI's
         self.retrace = obs_lib.RetraceDetector()
-        # args 3-5 are the fault / defense / attack-onset states — empty
-        # pytrees when the corresponding feature is off, so their donation
-        # slots contribute no buffers to the default program
+        # args 3-6 are the fault / defense / attack-onset / service states —
+        # empty pytrees when the corresponding feature is off, so their
+        # donation slots contribute no buffers to the default program
         self._round_fn = jax.jit(
             self.retrace.wrap("round_fn", self._build_round_fn()),
-            donate_argnums=(0, 1, 2, 3, 4, 5),
+            donate_argnums=(0, 1, 2, 3, 4, 5, 6),
             compiler_options=copts,
         )
         self._multi_round_fn = jax.jit(
             self.retrace.wrap("multi_round_fn", self._build_multi_round_fn()),
-            donate_argnums=(0, 1, 2, 3, 4, 5),
+            donate_argnums=(0, 1, 2, 3, 4, 5, 6),
             compiler_options=copts,
         )
         self._eval_fn = jax.jit(
@@ -392,15 +432,19 @@ class FedTrainer:
             self._per_client_weights, in_axes=(None, 0, 0, 0)
         )(flat_params, x, y, part_mask)
 
-    def _defense_branches(self, agg_honest: int):
+    def _defense_branches(self, agg_honest: int, trim_ratio=None):
         """Static ``lax.switch`` branch table for the adaptive ladder.
 
         Built at TRACE time (not in ``__init__``) so the sharded trainer's
         post-constructor ``_agg_impl`` override reaches the closures.  Every
         rung gets the trainer's full keyword surface (aggregators swallow
         unknown kwargs) with the fused epilogue off — see the mode gate in
-        ``__init__``."""
+        ``__init__``.  ``trim_ratio`` may be a TRACED scalar (the service
+        loop's rollback-widened fraction): the closures capture it at trace
+        time and only the degraded trimmed_mean path — which computes its
+        trim budget dynamically — ever consumes it."""
         cfg = self.cfg
+        extra = {} if trim_ratio is None else {"trim_ratio": trim_ratio}
         return defense_lib.make_branch_table(
             self.defense.ladder,
             honest_size=agg_honest,
@@ -418,7 +462,8 @@ class FedTrainer:
             dnc_iters=cfg.dnc_iters,
             dnc_sub_dim=cfg.dnc_sub_dim,
             dnc_c=cfg.dnc_c,
-            degraded=self.fault is not None,
+            degraded=self.fault is not None or cfg.service == "on",
+            **extra,
         )
 
     def _client_stack_momentum(self, flat_params, x, y, part_mask, m_prev):
@@ -427,6 +472,52 @@ class FedTrainer:
         return jax.vmap(
             self._per_client_momentum_step, in_axes=(None, 0, 0, 0, 0)
         )(flat_params, x, y, part_mask, m_prev)
+
+    def _service_draw(self, key, avail):
+        """Stratified service subsample over stable population ids.
+
+        Draws honest_size honest ids from [0, pop_h) and byz_size
+        Byzantine ids from [pop_h, population) — uniformly among the
+        AVAILABLE ids of each stratum (priority = U(0,1) + 2*offline, so
+        every available id outranks every offline one and ties within a
+        class are a uniform shuffle).  When a stratum has fewer available
+        clients than its quota the server still schedules a full slate
+        (static shapes) and tops up with offline ids; those rows carry
+        ``arrived=False`` and the deadline stage erases them, so the
+        shortfall shows up as effective-K degradation, not a shape change.
+
+        Returns ``(pop_ids [K] i32, arrived [K] bool)`` with honest rows
+        first — the stack layout every downstream stage (attack mask,
+        honest variance, aggregator honest_size) already assumes."""
+        cfg = self.cfg
+        kh, kb = jax.random.split(key)
+        pop_h = self._pop_h
+        prio_h = jax.random.uniform(kh, (pop_h,)) + jnp.where(
+            avail[:pop_h], 0.0, 2.0
+        )
+        ids_h = jnp.argsort(prio_h)[: cfg.honest_size]
+        if cfg.byz_size:
+            prio_b = jax.random.uniform(kb, (self._pop_b,)) + jnp.where(
+                avail[pop_h:], 0.0, 2.0
+            )
+            pop_ids = jnp.concatenate([
+                ids_h, pop_h + jnp.argsort(prio_b)[: cfg.byz_size],
+            ])
+        else:
+            pop_ids = ids_h
+        return pop_ids.astype(jnp.int32), avail[pop_ids]
+
+    @staticmethod
+    def _masked_honest_variance(w_h):
+        """Honest dispersion over the FINITE honest rows — the service
+        loop's variant of :func:`honest_variance` (deadline-missed rows are
+        NaN; including them would NaN the metric and false-trip the
+        rollback guard)."""
+        fin = agg_lib._finite_rows(w_h)
+        n = jnp.maximum(jnp.sum(fin).astype(jnp.float32), 1.0)
+        w0 = jnp.where(fin[:, None], w_h.astype(jnp.float32), 0.0)
+        mean = jnp.sum(w0, axis=0) / n
+        return jnp.sum(w0 * w0) / n - jnp.sum(mean * mean)
 
     def _iteration(self, carry, key, x_train, y_train, want_variance):
         """One global iteration: local steps -> attack -> channel -> agg.
@@ -459,7 +550,7 @@ class FedTrainer:
         cfg = self.cfg
         (
             flat_params, opt_state, client_m, fault_state, defense_state,
-            attack_iter,
+            attack_iter, service_state,
         ) = carry
         m_h, m_b = self._part_h, self._part_b
         # delayed attack: one traced bool gates EVERY Byzantine behavior
@@ -476,6 +567,7 @@ class FedTrainer:
             int(cfg.participation < 1.0)
             + int(cfg.bucket_size > 1)
             + int(self.fault is not None)
+            + int(cfg.service == "on")
         )
         keys = jax.random.split(key, 4 + n_extra)
         k_batch, k_chan, k_agg, k_msg = keys[:4]
@@ -502,6 +594,37 @@ class FedTrainer:
             next_extra += 1
         if self.fault is not None:
             k_drop, k_trans = jax.random.split(keys[next_extra])
+            next_extra += 1
+        pop_ids = arrived = widen = None
+        if cfg.service == "on":
+            with jax.named_scope("service_draw"):
+                # participation stage: draw this iteration's K-row slate
+                # from the available population, then advance the Markov
+                # churn — the draw sees the PRE-churn availability, so
+                # the reported 'available' count matches what the server
+                # scheduled against
+                k_churn, k_draw, k_dead = jax.random.split(
+                    keys[next_extra], 3
+                )
+                avail, widen = service_state
+                n_avail = jnp.sum(avail).astype(jnp.float32)
+                pop_ids, arrived = self._service_draw(k_draw, avail)
+                k_arr, k_dep = jax.random.split(k_churn)
+                avail = jnp.where(
+                    avail,
+                    ~jax.random.bernoulli(
+                        k_dep, cfg.churn_departure, avail.shape
+                    ),
+                    jax.random.bernoulli(
+                        k_arr, cfg.churn_arrival, avail.shape
+                    ),
+                )
+                service_state = (avail, widen)
+                # stable id -> data shard: a drawn client reads its own
+                # shard wherever the draw placed it in the stack
+                shard = self._pop_shard[pop_ids]
+                offsets = self.offsets[shard]
+                sizes = self.sizes[shard]
 
         with jax.named_scope("client_local_step"):
             # E local steps per client, each on a fresh with-replacement
@@ -587,7 +710,18 @@ class FedTrainer:
             # so toggling fusion never shifts the round's RNG stream
             oma_key = None
             if cfg.noise_var is not None and agg_lib.needs_oma_prepass(cfg.agg):
-                if (
+                if cfg.service == "on":
+                    # per-STABLE-ID links: a client's fade is a function of
+                    # its population id, not of where this iteration's
+                    # draw happened to place it in the stack.  The
+                    # deferred-OMA read is row-index-keyed, so the service
+                    # path always takes the standalone pass (the fused
+                    # epilogue is off under service anyway — degraded
+                    # aggregation has no single-read epilogue)
+                    w_stack = channel_lib.oma_by_id(
+                        k_chan, w_stack, pop_ids, cfg.noise_var
+                    )
+                elif (
                     self._fused_epilogue
                     and agg_lib.supports_fused_epilogue(cfg.agg)
                     and cfg.bucket_size == 1
@@ -601,6 +735,18 @@ class FedTrainer:
                     oma_key = k_chan
                 else:
                     w_stack = channel_lib.oma(k_chan, w_stack, cfg.noise_var)
+
+        n_absent = n_late = None
+        if cfg.service == "on":
+            with jax.named_scope("deadline"):
+                # the round closes NOW: drawn-but-offline rows and
+                # straggler rows are erased to NaN ("nothing received"),
+                # and everything downstream — detector freeze, degraded
+                # aggregation, effective-K telemetry — sees exactly the
+                # fault subsystem's erasure convention
+                w_stack, n_absent, n_late = fault_lib.apply_deadline(
+                    k_dead, w_stack, arrived, cfg.straggler_prob
+                )
 
         defense_metrics = ()
         rung = None
@@ -617,9 +763,33 @@ class FedTrainer:
                 score, finite = defense_lib.client_scores(
                     w_stack, flat_params
                 )
-                det, flags = defense_lib.detector_update(
-                    det, score, finite, self.defense.detector
-                )
+                if cfg.service == "on":
+                    # population-keyed detector: gather the drawn ids'
+                    # rows, update them under their own first-observation
+                    # markers (dev == 0 <=> never updated — the seed
+                    # writes dev >= eps), scatter back.  Ids absent from
+                    # the draw keep their baselines verbatim, so scores
+                    # survive non-participation.
+                    step, ema, dev, cus = det
+                    first = dev[pop_ids] == 0.0
+                    (_, ema_r, dev_r, cus_r), flags = (
+                        defense_lib.detector_update(
+                            (step, ema[pop_ids], dev[pop_ids],
+                             cus[pop_ids]),
+                            score, finite, self.defense.detector,
+                            first=first,
+                        )
+                    )
+                    det = (
+                        step + 1,
+                        ema.at[pop_ids].set(ema_r),
+                        dev.at[pop_ids].set(dev_r),
+                        cus.at[pop_ids].set(cus_r),
+                    )
+                else:
+                    det, flags = defense_lib.detector_update(
+                        det, score, finite, self.defense.detector
+                    )
                 n_flagged = jnp.sum(flags)
                 pol, suspicious = defense_lib.policy_update(
                     pol, n_flagged, self.defense.policy
@@ -669,6 +839,15 @@ class FedTrainer:
             # arithmetic stays f32 via promotion / in-kernel upcast, and
             # the aggregate is cast back so the params carry stays f32
             w_agg = w_for_agg.astype(self._stack_dtype)
+            # service rounds: the rollback-widened trim fraction rides the
+            # carry as a traced scalar — only the degraded trimmed_mean
+            # path (dynamic trim budget) consumes it; every other
+            # aggregator swallows it via **_
+            service_kw = {}
+            if cfg.service == "on":
+                service_kw["trim_ratio"] = jnp.minimum(
+                    jnp.float32(0.1) * widen, 0.45
+                )
             if self.defense is not None and self.defense.mode == "adaptive":
                 # branchless rung dispatch (defense/policy.py): ONE
                 # lax.switch over the static ladder table, every branch
@@ -676,7 +855,10 @@ class FedTrainer:
                 # configured aggregator (cfg.validate enforces it), so an
                 # attack-free run aggregates exactly as --defense off does
                 aggregated = defense_lib.aggregate_switch(
-                    rung, self._defense_branches(agg_honest),
+                    rung,
+                    self._defense_branches(
+                        agg_honest, **service_kw
+                    ),
                     w_agg, flat_params, k_agg,
                 )
             else:
@@ -701,13 +883,15 @@ class FedTrainer:
                     dnc_iters=cfg.dnc_iters,
                     dnc_sub_dim=cfg.dnc_sub_dim,
                     dnc_c=cfg.dnc_c,
-                    # graceful degradation (ops/aggregators.py): under faults
-                    # the static rules adapt to the per-round effective K;
-                    # False traces the literal pre-fault aggregator code
-                    degraded=self.fault is not None,
+                    # graceful degradation (ops/aggregators.py): under
+                    # faults and service deadlines the static rules adapt
+                    # to the per-round effective K; False traces the
+                    # literal pre-fault aggregator code
+                    degraded=self.fault is not None or cfg.service == "on",
+                    **service_kw,
                 )
             aggregated = aggregated.astype(jnp.float32)
-            if self.fault is not None:
+            if self.fault is not None or cfg.service == "on":
                 # receiver-side finite-guard — the last line of defense the
                 # fault contract promises: whatever non-finite value leaks
                 # through aggregation (e.g. zero clients delivered anything
@@ -728,7 +912,13 @@ class FedTrainer:
             new_flat = self._constrain_params(new_flat)
         variance = jax.lax.cond(
             want_variance,
-            lambda w: honest_variance(w, m_h),
+            (
+                # deadline-missed honest rows are NaN — the service metric
+                # is the dispersion of what the round actually received
+                (lambda w: self._masked_honest_variance(w[:m_h]))
+                if cfg.service == "on"
+                else (lambda w: honest_variance(w, m_h))
+            ),
             lambda w: jnp.float32(0.0),
             w_stack,
         )
@@ -736,7 +926,7 @@ class FedTrainer:
             attack_iter = attack_iter + 1
         carry_out = (
             new_flat, opt_state, client_m, fault_state, defense_state,
-            attack_iter,
+            attack_iter, service_state,
         )
         if self.fault is not None:
             # effective K = finite rows the receiver actually aggregates
@@ -748,7 +938,14 @@ class FedTrainer:
             )
         else:
             fault_metrics = ()
-        return carry_out, (variance, fault_metrics, defense_metrics)
+        if cfg.service == "on":
+            eff_k = jnp.sum(agg_lib._finite_rows(w_stack)).astype(jnp.float32)
+            service_metrics = jnp.stack([n_avail, n_absent, n_late, eff_k])
+        else:
+            service_metrics = ()
+        return carry_out, (
+            variance, fault_metrics, defense_metrics, service_metrics
+        )
 
     def _iteration_streamed(self, carry, key, x_train, y_train, want_variance):
         """Cohort-streamed global iteration: K >> HBM.
@@ -786,9 +983,9 @@ class FedTrainer:
         cfg = self.cfg
         (
             flat_params, opt_state, client_m, fault_state, defense_state,
-            attack_iter,
+            attack_iter, service_state,
         ) = carry
-        m_h, m_b = self._part_h, self._part_b  # == honest/byz (full part.)
+        m_h, m_b = self._part_h, self._part_b  # participating counts
         cohort = cfg.cohort_size
         n_h_chunks = m_h // cohort
         n_chunks = n_h_chunks + m_b // cohort
@@ -801,14 +998,81 @@ class FedTrainer:
 
         # identical round-level split to the resident path (replay/ckpt
         # compatible); chunk sub-streams below are cohort_key fold-ins
-        n_extra = int(self.fault is not None)
+        n_extra = (
+            int(cfg.participation < 1.0)
+            + int(self.fault is not None)
+            + int(cfg.service == "on")
+        )
         keys = jax.random.split(key, 4 + n_extra)
         k_batch, k_chan, k_agg, k_msg = keys[:4]
         del k_agg  # mean/median/trimmed_mean/gm2 never consume it
+        next_extra = 4
+        offsets, sizes = self.offsets, self.sizes
+        if cfg.participation < 1.0:
+            # subsample-then-stream: the resident stratified draw verbatim
+            # (same key slot, same permutation calls), then the cohort
+            # scan walks the [m] PARTICIPANT index space — cfg.validate
+            # pins cohort_size to divide both participating counts, so
+            # chunk purity still holds (byz participants land last)
+            k_part = keys[next_extra]
+            next_extra += 1
+            kh, kb = jax.random.split(k_part)
+            part = jax.random.permutation(kh, cfg.honest_size)[:m_h]
+            if m_b:
+                part = jnp.concatenate([
+                    part,
+                    cfg.honest_size
+                    + jax.random.permutation(kb, cfg.byz_size)[:m_b],
+                ])
+            offsets = self.offsets[part]
+            sizes = self.sizes[part]
         stale = ge_bad = ()
         if self.fault is not None:
-            _k_drop, k_trans = jax.random.split(keys[4])
+            _k_drop, k_trans = jax.random.split(keys[next_extra])
+            next_extra += 1
             stale, ge_bad = fault_state  # stale is () (needs_stale rejected)
+        pop_ids = widen = missed = None
+        n_avail = n_absent = n_late = None
+        if cfg.service == "on":
+            with jax.named_scope("service_draw"):
+                # same draw/churn/deadline semantics as the resident path;
+                # the [K]-resident pop_ids/missed masks are i32/bool rows
+                # (O(K), not O(K*d)) so keeping them resident costs
+                # nothing against the streamed peak
+                k_churn, k_draw, k_dead = jax.random.split(
+                    keys[next_extra], 3
+                )
+                avail, widen = service_state
+                n_avail = jnp.sum(avail).astype(jnp.float32)
+                pop_ids, arrived = self._service_draw(k_draw, avail)
+                k_arr, k_dep = jax.random.split(k_churn)
+                avail = jnp.where(
+                    avail,
+                    ~jax.random.bernoulli(
+                        k_dep, cfg.churn_departure, avail.shape
+                    ),
+                    jax.random.bernoulli(
+                        k_arr, cfg.churn_arrival, avail.shape
+                    ),
+                )
+                service_state = (avail, widen)
+                shard = self._pop_shard[pop_ids]
+                offsets = self.offsets[shard]
+                sizes = self.sizes[shard]
+                if cfg.straggler_prob > 0.0:
+                    late = jnp.logical_and(
+                        arrived,
+                        jax.random.bernoulli(
+                            k_dead, cfg.straggler_prob, (k_total,)
+                        ),
+                    )
+                else:
+                    late = jnp.zeros((k_total,), bool)
+                missed = jnp.logical_or(late, jnp.logical_not(arrived))
+                n_absent = jnp.sum(
+                    jnp.logical_not(arrived)
+                ).astype(jnp.float32)
+                n_late = jnp.sum(late).astype(jnp.float32)
         byz_mask = self._part_mask
         steps_b = cfg.local_steps * cfg.batch_size
         # ONE [K, E*B] index draw under the resident path's exact key and
@@ -817,7 +1081,7 @@ class FedTrainer:
         # chunk's batches (hence, with channel/fault off, the chunk rows
         # themselves) bit-identical to the resident stack's rows
         idx_all = data_lib.sample_client_batch_indices(
-            k_batch, self.offsets, self.sizes, steps_b
+            k_batch, offsets, sizes, steps_b
         )
 
         def rebuild_full(c_idx):
@@ -875,11 +1139,33 @@ class FedTrainer:
             if cfg.noise_var is not None and agg_lib.needs_oma_prepass(
                 cfg.agg
             ):
-                chunk = channel_lib.oma(
-                    channel_lib.cohort_key(k_chan, c_idx), chunk,
-                    cfg.noise_var,
+                if cfg.service == "on":
+                    # per-STABLE-ID links under the ROUND key (not the
+                    # cohort fold-in): fold_in(k_chan, id) is invariant to
+                    # which chunk the draw placed a client in, so the
+                    # streamed realization matches the resident path's
+                    # bit for bit
+                    ids_c = jax.lax.dynamic_slice_in_dim(
+                        pop_ids, off, cohort
+                    )
+                    chunk = channel_lib.oma_by_id(
+                        k_chan, chunk, ids_c, cfg.noise_var
+                    )
+                else:
+                    chunk = channel_lib.oma(
+                        channel_lib.cohort_key(k_chan, c_idx), chunk,
+                        cfg.noise_var,
+                    )
+            chunk = self._constrain_stack(chunk)
+            if cfg.service == "on":
+                # deadline erasure LAST (as in the resident path), sliced
+                # from the resident [K] mask so every rebuild pass sees
+                # identical chunks
+                miss_c = jax.lax.dynamic_slice_in_dim(missed, off, cohort)
+                chunk = jnp.where(
+                    miss_c[:, None], jnp.asarray(jnp.nan, chunk.dtype), chunk
                 )
-            return self._constrain_stack(chunk), ge_c, n_erased, n_corrupt
+            return chunk, ge_c, n_erased, n_corrupt
 
         def rebuild(c_idx):
             return rebuild_full(c_idx)[0]
@@ -894,6 +1180,7 @@ class FedTrainer:
             jnp.int32(0),                # finite-row count
             jnp.zeros(d, jnp.float32),   # honest-row sum (dispersion)
             jnp.float32(0.0),            # honest sum of squared norms
+            jnp.float32(0.0) if cfg.service == "on" else (),  # honest fin
             ge_bad if needs_ge else (),
             jnp.float32(0.0),            # erased
             jnp.float32(0.0),            # corrupt
@@ -904,20 +1191,26 @@ class FedTrainer:
 
         def obs_body(carry_o, c_idx):
             (
-                s_all, s_fin, n_fin, s_h, ssq_h, ge_acc, n_er, n_co,
-                det_rows, n_flag, max_sc,
+                s_all, s_fin, n_fin, s_h, ssq_h, n_h_fin, ge_acc, n_er,
+                n_co, det_rows, n_flag, max_sc,
             ) = carry_o
             chunk, ge_c, er, co = rebuild_full(c_idx)
             fin = agg_lib._finite_rows(chunk)
             c32 = chunk.astype(jnp.float32)
+            c_fin = jnp.where(fin[:, None], c32, 0.0)
             s_all = s_all + jnp.sum(c32, axis=0)
-            s_fin = s_fin + jnp.sum(
-                jnp.where(fin[:, None], c32, 0.0), axis=0
-            )
+            s_fin = s_fin + jnp.sum(c_fin, axis=0)
             n_fin = n_fin + jnp.sum(fin)
             is_h = (c_idx < n_h_chunks).astype(jnp.float32)
-            s_h = s_h + is_h * jnp.sum(c32, axis=0)
-            ssq_h = ssq_h + is_h * jnp.sum(c32 * c32)
+            if cfg.service == "on":
+                # deadline-missed honest rows are NaN: the dispersion
+                # moments run over what the round actually received
+                s_h = s_h + is_h * jnp.sum(c_fin, axis=0)
+                ssq_h = ssq_h + is_h * jnp.sum(c_fin * c_fin)
+                n_h_fin = n_h_fin + is_h * jnp.sum(fin).astype(jnp.float32)
+            else:
+                s_h = s_h + is_h * jnp.sum(c32, axis=0)
+                ssq_h = ssq_h + is_h * jnp.sum(c32 * c32)
             if self.fault is not None:
                 n_er, n_co = n_er + er, n_co + co
                 if needs_ge:
@@ -929,42 +1222,63 @@ class FedTrainer:
                 # the shared scalar step (incremented ONCE after the scan)
                 ema, dev, cus = det_rows
                 off = c_idx * cohort
-                det_c = (
-                    det[0],
-                    jax.lax.dynamic_slice_in_dim(ema, off, cohort),
-                    jax.lax.dynamic_slice_in_dim(dev, off, cohort),
-                    jax.lax.dynamic_slice_in_dim(cus, off, cohort),
-                )
                 score, score_fin = defense_lib.client_scores(
                     chunk, flat_params
                 )
-                (_, ema_c, dev_c, cus_c), flags = (
-                    defense_lib.detector_update(
-                        det_c, score, score_fin, self.defense.detector
+                if cfg.service == "on":
+                    # population-keyed rows: gather this chunk's drawn ids,
+                    # update under their own first-observation markers
+                    # (dev == 0 <=> never updated), scatter back — same
+                    # contract as the resident service path
+                    rows_c = jax.lax.dynamic_slice_in_dim(
+                        pop_ids, off, cohort
                     )
-                )
-                det_rows = (
-                    jax.lax.dynamic_update_slice_in_dim(
-                        ema, ema_c, off, axis=0
-                    ),
-                    jax.lax.dynamic_update_slice_in_dim(
-                        dev, dev_c, off, axis=0
-                    ),
-                    jax.lax.dynamic_update_slice_in_dim(
-                        cus, cus_c, off, axis=0
-                    ),
-                )
+                    det_c = (det[0], ema[rows_c], dev[rows_c], cus[rows_c])
+                    (_, ema_c, dev_c, cus_c), flags = (
+                        defense_lib.detector_update(
+                            det_c, score, score_fin, self.defense.detector,
+                            first=det_c[2] == 0.0,
+                        )
+                    )
+                    det_rows = (
+                        ema.at[rows_c].set(ema_c),
+                        dev.at[rows_c].set(dev_c),
+                        cus.at[rows_c].set(cus_c),
+                    )
+                else:
+                    det_c = (
+                        det[0],
+                        jax.lax.dynamic_slice_in_dim(ema, off, cohort),
+                        jax.lax.dynamic_slice_in_dim(dev, off, cohort),
+                        jax.lax.dynamic_slice_in_dim(cus, off, cohort),
+                    )
+                    (_, ema_c, dev_c, cus_c), flags = (
+                        defense_lib.detector_update(
+                            det_c, score, score_fin, self.defense.detector
+                        )
+                    )
+                    det_rows = (
+                        jax.lax.dynamic_update_slice_in_dim(
+                            ema, ema_c, off, axis=0
+                        ),
+                        jax.lax.dynamic_update_slice_in_dim(
+                            dev, dev_c, off, axis=0
+                        ),
+                        jax.lax.dynamic_update_slice_in_dim(
+                            cus, cus_c, off, axis=0
+                        ),
+                    )
                 n_flag = n_flag + jnp.sum(flags)
                 max_sc = jnp.maximum(max_sc, jnp.max(score))
             return (
-                s_all, s_fin, n_fin, s_h, ssq_h, ge_acc, n_er, n_co,
-                det_rows, n_flag, max_sc,
+                s_all, s_fin, n_fin, s_h, ssq_h, n_h_fin, ge_acc, n_er,
+                n_co, det_rows, n_flag, max_sc,
             ), None
 
         with jax.named_scope("stream_observe"):
             (
-                s_all, s_fin, n_fin, s_h, ssq_h, ge_new, n_er, n_co,
-                det_rows, n_flag, max_sc,
+                s_all, s_fin, n_fin, s_h, ssq_h, n_h_fin, ge_new, n_er,
+                n_co, det_rows, n_flag, max_sc,
             ), _ = jax.lax.scan(
                 obs_body, obs_init, jnp.arange(n_chunks, dtype=jnp.int32)
             )
@@ -991,12 +1305,18 @@ class FedTrainer:
         with jax.named_scope("stream_aggregate"):
             kw = dict(
                 k=k_total, d=d, n_chunks=n_chunks,
-                degraded=self.fault is not None,
+                degraded=self.fault is not None or cfg.service == "on",
                 sum_all=s_all, sum_finite=s_fin, n_finite=n_fin,
                 guess=flat_params, maxiter=cfg.agg_maxiter,
                 tol=cfg.agg_tol, quantile=cfg.cohort_quantile,
                 sketch_bins=cfg.cohort_sketch_bins,
             )
+            if cfg.service == "on":
+                # rollback-widened trim fraction — only the streamed
+                # trimmed_mean's dynamic trim budget consumes it
+                kw["trim_ratio"] = jnp.minimum(
+                    jnp.float32(0.1) * widen, 0.45
+                )
             if self.defense is not None and self.defense.mode == "adaptive":
                 # streamed rung dispatch: one lax.switch over nullary
                 # streamed closures (cfg.validate pins every rung to a
@@ -1011,7 +1331,7 @@ class FedTrainer:
             else:
                 aggregated = agg_lib.stream_aggregate(cfg.agg, rebuild, **kw)
             aggregated = aggregated.astype(jnp.float32)
-            if self.fault is not None:
+            if self.fault is not None or cfg.service == "on":
                 # same receiver-side finite-guard as the resident path
                 aggregated = jnp.where(
                     jnp.isfinite(aggregated), aggregated, flat_params
@@ -1028,17 +1348,21 @@ class FedTrainer:
 
         # streamed honest dispersion from the observation-pass moments:
         # (1/H) sum ||w_i||^2 - ||mean_h||^2 == mean_i ||w_i - mean_h||^2
-        mean_h = s_h / jnp.float32(m_h)
+        n_h = (
+            jnp.maximum(n_h_fin, 1.0) if cfg.service == "on"
+            else jnp.float32(m_h)
+        )
+        mean_h = s_h / n_h
         variance = jnp.where(
             want_variance,
-            ssq_h / jnp.float32(m_h) - jnp.sum(mean_h * mean_h),
+            ssq_h / n_h - jnp.sum(mean_h * mean_h),
             jnp.float32(0.0),
         )
         if self._attack_onset is not None:
             attack_iter = attack_iter + 1
         carry_out = (
             new_flat, opt_state, client_m, fault_state, defense_state,
-            attack_iter,
+            attack_iter, service_state,
         )
         if self.fault is not None:
             # dropout is structurally absent under streaming (needs_stale
@@ -1048,22 +1372,34 @@ class FedTrainer:
             ])
         else:
             fault_metrics = ()
-        return carry_out, (variance, fault_metrics, defense_metrics)
+        if cfg.service == "on":
+            service_metrics = jnp.stack([
+                n_avail, n_absent, n_late, n_fin.astype(jnp.float32),
+            ])
+        else:
+            service_metrics = ()
+        return carry_out, (
+            variance, fault_metrics, defense_metrics, service_metrics
+        )
 
     def _round_core(
         self, flat_params, opt_state, client_m, fault_state, defense_state,
-        attack_iter, round_key, x_train, y_train
+        attack_iter, service_state, round_key, x_train, y_train
     ):
         """One round (display_interval scanned iterations) as a pure fn.
 
         Returns ``(params, opt_state, client_m, fault_state, defense_state,
-        attack_iter, variance, fault_metrics, defense_metrics)`` where
-        fault_metrics is the round's reduced [dropped, erased, corrupt,
-        effective_k] (event counts summed over the interval, effective K at
-        its per-iteration MINIMUM — the worst moment is what resilience
-        claims are about) and defense_metrics is the [6] vector of
-        ``defense/events.METRIC_KEYS`` — either is ``()`` when its feature
-        is off, keeping that program's output structure free."""
+        attack_iter, service_state, variance, fault_metrics,
+        defense_metrics, service_metrics)`` where fault_metrics is the
+        round's reduced [dropped, erased, corrupt, effective_k] (event
+        counts summed over the interval, effective K at its per-iteration
+        MINIMUM — the worst moment is what resilience claims are about),
+        defense_metrics is the [6] vector of ``defense/events.METRIC_KEYS``
+        and service_metrics is the reduced [available, absent, late,
+        effective_k] participation vector (availability at round end,
+        deadline-event counts summed, effective K at its minimum) — each is
+        ``()`` when its feature is off, keeping that program's output
+        structure free."""
         interval = self.cfg.display_interval
         keys = jax.random.split(round_key, interval)
         want = jnp.arange(interval) == interval - 1
@@ -1072,12 +1408,14 @@ class FedTrainer:
             key, want_var = kf
             return self._iteration(carry, key, x_train, y_train, want_var)
 
-        (final, opt_final, m_final, f_final, d_final, a_final), (
-            variances, fms, dms
+        (
+            final, opt_final, m_final, f_final, d_final, a_final, s_final,
+        ), (
+            variances, fms, dms, sms
         ) = jax.lax.scan(
             it,
             (flat_params, opt_state, client_m, fault_state, defense_state,
-             attack_iter),
+             attack_iter, service_state),
             (keys, want),
         )
         if self.fault is not None:
@@ -1103,9 +1441,19 @@ class FedTrainer:
             ])
         else:
             defense_metrics = ()
+        if self.cfg.service == "on":
+            # availability is a level (report the round's last value);
+            # absences/lates are events (sum); effective K at its minimum,
+            # same worst-moment convention as the fault reduce
+            service_metrics = jnp.stack([
+                sms[-1, 0], jnp.sum(sms[:, 1]), jnp.sum(sms[:, 2]),
+                jnp.min(sms[:, 3]),
+            ])
+        else:
+            service_metrics = ()
         return (
-            final, opt_final, m_final, f_final, d_final, a_final,
-            variances[-1], fault_metrics, defense_metrics,
+            final, opt_final, m_final, f_final, d_final, a_final, s_final,
+            variances[-1], fault_metrics, defense_metrics, service_metrics,
         )
 
     def _build_round_fn(self):
@@ -1125,27 +1473,32 @@ class FedTrainer:
 
         def multi_fn(
             flat_params, opt_state, client_m, fault_state, defense_state,
-            attack_iter, rounds, x_train, y_train,
+            attack_iter, service_state, rounds, x_train, y_train,
         ):
             def body(carry, r):
-                fp, os, cm, fs, ds, ai = carry
-                fp, os, cm, fs, ds, ai, var, fm, dm = self._round_core(
-                    fp, os, cm, fs, ds, ai, jax.random.fold_in(base_key, r),
-                    x_train, y_train,
+                fp, os, cm, fs, ds, ai, ss = carry
+                fp, os, cm, fs, ds, ai, ss, var, fm, dm, sm = (
+                    self._round_core(
+                        fp, os, cm, fs, ds, ai, ss,
+                        jax.random.fold_in(base_key, r), x_train, y_train,
+                    )
                 )
-                return (fp, os, cm, fs, ds, ai), (var, fm, dm)
+                return (fp, os, cm, fs, ds, ai, ss), (var, fm, dm, sm)
 
-            (final, opt_final, m_final, f_final, d_final, a_final), (
-                variances, fms, dms
+            (
+                final, opt_final, m_final, f_final, d_final, a_final,
+                s_final,
+            ), (
+                variances, fms, dms, sms
             ) = jax.lax.scan(
                 body,
                 (flat_params, opt_state, client_m, fault_state,
-                 defense_state, attack_iter),
+                 defense_state, attack_iter, service_state),
                 rounds,
             )
             return (
                 final, opt_final, m_final, f_final, d_final, a_final,
-                variances, fms, dms,
+                s_final, variances, fms, dms, sms,
             )
 
         return multi_fn
@@ -1207,14 +1560,22 @@ class FedTrainer:
         (~3x the round's compute on a tunneled chip); callers convert when
         they actually consume the value."""
         round_key = jax.random.fold_in(self._base_key, round_idx)
+        if self._rollback_epoch:
+            # warm rollback: re-running a round after a restore must NOT
+            # replay the exact draws that diverged — salt the round key
+            # with the rollback epoch (host-side int, so the jitted
+            # program is untouched and epoch 0 keys are bit-identical to
+            # the pre-rollback stream)
+            round_key = jax.random.fold_in(round_key, self._rollback_epoch)
         (
             self.flat_params, self.server_opt_state, self.client_m,
             self.fault_state, self.defense_state, self.attack_iter,
-            variance, self.last_fault_metrics, self.last_defense_metrics,
+            self.service_state, variance, self.last_fault_metrics,
+            self.last_defense_metrics, self.last_service_metrics,
         ) = self._round_fn(
             self.flat_params, self.server_opt_state, self.client_m,
             self.fault_state, self.defense_state, self.attack_iter,
-            round_key, self.x_train, self.y_train,
+            self.service_state, round_key, self.x_train, self.y_train,
         )
         return variance
 
@@ -1230,11 +1591,11 @@ class FedTrainer:
         (
             self.flat_params, self.server_opt_state, self.client_m,
             self.fault_state, self.defense_state, self.attack_iter,
-            variances, fms, dms,
+            self.service_state, variances, fms, dms, sms,
         ) = self._multi_round_fn(
             self.flat_params, self.server_opt_state, self.client_m,
             self.fault_state, self.defense_state, self.attack_iter,
-            rounds, self.x_train, self.y_train,
+            self.service_state, rounds, self.x_train, self.y_train,
         )
         # [num_rounds, 4] / [num_rounds, 6] stacked rows (the LAST round's
         # row is what run_round would have reported); () when off
@@ -1243,6 +1604,9 @@ class FedTrainer:
         )
         self.last_defense_metrics = (
             dms[-1] if self.defense is not None else ()
+        )
+        self.last_service_metrics = (
+            sms[-1] if self.cfg.service == "on" else ()
         )
         return variances
 
@@ -1312,13 +1676,38 @@ class FedTrainer:
             for path_key in defense_lib.events.PATH_KEYS.values():
                 paths[path_key] = []
             prev_rung = int(self.defense_state[1][0])
+        if cfg.service == "on":
+            # per-round participation telemetry under deadline semantics:
+            # availability level at round end, deadline-event counts, and
+            # the round's minimum effective K (fault mode is mutually
+            # exclusive with service, so effectiveKPath has one owner)
+            paths["serviceAvailPath"] = []
+            paths["serviceAbsentPath"] = []
+            paths["serviceLatePath"] = []
+            paths["effectiveKPath"] = []
         log(
             f"[0/{cfg.rounds}](interval: {cfg.display_interval}) "
             f"train: loss={tr_loss:.4f} acc={tr_acc:.4f} "
             f"val: loss={va_loss:.4f} acc={va_acc:.4f}"
         )
 
-        for r in range(start_round, cfg.rounds):
+        # warm rollback (service rounds): keep a host-side copy of the last
+        # GOOD end-of-round state; when the divergence guard trips, restore
+        # it, widen the trim fraction and re-run the round under an
+        # epoch-salted key instead of dying or replaying the same draws
+        rollback_armed = cfg.service == "on" and cfg.rollback == "on"
+        snapshot = None
+        recent_val: List[float] = []
+
+        def _state_tuple():
+            return (
+                self.flat_params, self.server_opt_state, self.client_m,
+                self.fault_state, self.defense_state, self.attack_iter,
+                self.service_state,
+            )
+
+        r = start_round
+        while r < cfg.rounds:
             profiler.round_start(r)  # window mode: open trace entering [A, B)
             lowerings_before = self.retrace.count("round_fn")
             t0 = time.perf_counter()
@@ -1335,6 +1724,61 @@ class FedTrainer:
             with obs.span("eval", stage="round", round=r + 1), \
                     profiler.phase("eval"):
                 (tr_loss, tr_acc), (va_loss, va_acc) = eval_pair()
+            if rollback_armed:
+                # guard BEFORE the record appends: a tripped round
+                # contributes nothing to the paths/event stream except the
+                # rollback event itself
+                var_f = float(variance)
+                reason = None
+                if not (
+                    math.isfinite(tr_loss) and math.isfinite(va_loss)
+                    and math.isfinite(var_f)
+                ):
+                    reason = "non_finite"
+                elif (
+                    self.defense is not None
+                    and cfg.rollback_cusum > 0.0
+                    and float(np.asarray(self.last_defense_metrics)[4])
+                    >= cfg.rollback_cusum
+                ):
+                    reason = "cusum_spike"
+                elif len(recent_val) >= 3:
+                    med = sorted(recent_val)[len(recent_val) // 2]
+                    if va_loss > cfg.rollback_loss_factor * max(med, 1e-3):
+                        reason = "loss_spike"
+                if (
+                    reason is not None
+                    and snapshot is not None
+                    and self._rollbacks_done < cfg.rollback_max
+                ):
+                    host_state, shardings, snap_round = snapshot
+                    (
+                        self.flat_params, self.server_opt_state,
+                        self.client_m, self.fault_state, self.defense_state,
+                        self.attack_iter, self.service_state,
+                    ) = jax.tree.map(jax.device_put, host_state, shardings)
+                    avail, widen = self.service_state
+                    self.service_state = (
+                        avail, widen * jnp.float32(cfg.rollback_widen)
+                    )
+                    self._rollbacks_done += 1
+                    # epoch-salting the round keys (run_round) breaks the
+                    # replay of the diverging draws; same shapes/dtypes, so
+                    # the jitted program does not retrace
+                    self._rollback_epoch = self._rollbacks_done
+                    obs.emit(
+                        "rollback", round=r, restored_round=snap_round,
+                        reason=reason, epoch=self._rollback_epoch,
+                        widen=float(widen) * cfg.rollback_widen,
+                    )
+                    log(
+                        f"[rollback {self._rollbacks_done}"
+                        f"/{cfg.rollback_max}] round {r + 1} diverged "
+                        f"({reason}); restored round {snap_round}, trim "
+                        f"widened x{cfg.rollback_widen:.2f}"
+                    )
+                    profiler.round_end(r)
+                    continue
             paths["trainLossPath"].append(tr_loss)
             paths["trainAccPath"].append(tr_acc)
             paths["valLossPath"].append(va_loss)
@@ -1362,6 +1806,26 @@ class FedTrainer:
                 var_str += (
                     f" effK={eff_k:.0f} drop={dropped:.0f} "
                     f"erase={erased:.0f} corrupt={corrupt:.0f}"
+                )
+            service_metrics = None
+            if cfg.service == "on":
+                avail_m, absent_m, late_m, eff_k = (
+                    float(v) for v in np.asarray(self.last_service_metrics)
+                )
+                paths["serviceAvailPath"].append(avail_m)
+                paths["serviceAbsentPath"].append(absent_m)
+                paths["serviceLatePath"].append(late_m)
+                paths["effectiveKPath"].append(eff_k)
+                service_metrics = {
+                    "available": avail_m,
+                    "absent": absent_m,
+                    "late": late_m,
+                    "effective_k": eff_k,
+                }
+                obs.emit("participation", round=r, **service_metrics)
+                var_str += (
+                    f" avail={avail_m:.0f} effK={eff_k:.0f} "
+                    f"late={late_m:.0f}"
                 )
             if self.defense is not None:
                 dmetrics = defense_lib.events.round_metrics(
@@ -1393,6 +1857,7 @@ class FedTrainer:
                 rounds_per_sec=1.0 / dt,
                 compiled=compiled,
                 fault_metrics=fault_metrics,
+                service_metrics=service_metrics,
                 # per-round watermark (device allocator stats, or host RSS
                 # on backends without memory_stats) — host-side reads only,
                 # after the existing block_until_ready barrier
@@ -1403,11 +1868,25 @@ class FedTrainer:
                 f"train: loss={tr_loss:.4f} acc={tr_acc:.4f} "
                 f"val: loss={va_loss:.4f} acc={va_acc:.4f}{var_str}"
             )
+            if rollback_armed:
+                recent_val.append(va_loss)
+                if len(recent_val) > 8:
+                    recent_val.pop(0)
+                # snapshot BEFORE checkpoint_fn: a corrupting checkpoint
+                # hook (tests force divergence through it) must not be able
+                # to poison the restore point
+                state = _state_tuple()
+                snapshot = (
+                    jax.tree.map(np.asarray, state),
+                    jax.tree.map(lambda x: x.sharding, state),
+                    r + 1,
+                )
             if checkpoint_fn is not None:
                 with obs.span("checkpoint", round=r + 1), \
                         profiler.phase("checkpoint"):
                     checkpoint_fn(r + 1, self)
             profiler.round_end(r)  # window mode: close trace leaving [A, B)
+            r += 1
         return paths
 
     @property

@@ -41,6 +41,12 @@ REFERENCE_KEY_MAP = {
     "erased": "faultErasedPath",
     "corrupt": "faultCorruptPath",
     "effective_k": "effectiveKPath",
+    # service-round fields (kind "round" under --service on; the round
+    # closes at its deadline, so these are per-round participation
+    # telemetry — see fed/train.py service_metrics)
+    "available": "serviceAvailPath",
+    "absent": "serviceAbsentPath",
+    "late": "serviceLatePath",
     # defense-event fields (kind "defense"; defense/events.PATH_KEYS is the
     # authoritative copy — tests/test_defense.py pins the two in sync)
     "rung": "defenseRungPath",
@@ -60,6 +66,10 @@ _REQUIRED: Dict[str, tuple] = {
     "retrace": ("counts", "steady_state_ok"),
     "run_end": ("elapsed_secs", "rounds_run"),
     "defense": ("round", "rung", "flagged"),
+    # service rounds (fed/train.py): per-round participation summary and
+    # the (rare) warm-rollback restore event
+    "participation": ("round", "available", "absent", "late", "effective_k"),
+    "rollback": ("round", "restored_round", "reason", "epoch"),
     # measurement layer (obs/profile.py, obs/ledger.py)
     "profile": ("dir",),
     "perf": ("metric", "value", "platform"),
@@ -118,6 +128,7 @@ class Collector:
         rounds_per_sec: Optional[float] = None,
         compiled: Optional[bool] = None,
         fault_metrics: Optional[Dict[str, float]] = None,
+        service_metrics: Optional[Dict[str, float]] = None,
         memory: Optional[Dict[str, Any]] = None,
     ) -> None:
         fields: Dict[str, Any] = dict(
@@ -136,6 +147,8 @@ class Collector:
             fields["compiled"] = compiled
         if fault_metrics:
             fields.update(fault_metrics)
+        if service_metrics:
+            fields.update(service_metrics)
         if memory:
             # watermark trio from obs.profile.device_memory — flat fields,
             # with mem_source labeling device allocator stats vs host RSS

@@ -112,6 +112,47 @@ def oma_terms(key: jax.Array, k: int, d: int, noise_var: float):
     return h_r, h_i, h_sq, n_r, n_i
 
 
+def _oma_row(key: jax.Array, row: jnp.ndarray, noise_var) -> jnp.ndarray:
+    """One client's OMA link, keyed independently of the stack layout.
+
+    Same physics, floor and split discipline as :func:`oma_terms` (fade key
+    first, then real/imag noise), but for a single [d] row under its OWN
+    key — the per-population-id realization :func:`oma_by_id` vmaps.
+    """
+    key_h, key_nr, key_ni = jax.random.split(key, 3)
+    kr, ki = jax.random.split(key_h)
+    std = 1.0 / math.sqrt(2.0)
+    h_r = std * jax.random.normal(kr, (), dtype=jnp.float32)
+    h_i = std * jax.random.normal(ki, (), dtype=jnp.float32)
+    d = row.shape[0]
+    scale = jnp.sqrt(jnp.asarray(noise_var, jnp.float32))
+    n_r = scale * jax.random.normal(key_nr, (d,), dtype=jnp.float32)
+    n_i = scale * jax.random.normal(key_ni, (d,), dtype=jnp.float32)
+    h_sq = jnp.maximum(h_r**2 + h_i**2, HSQ_FLOOR)
+    return row + (h_r * n_r + h_i * n_i) / h_sq
+
+
+def oma_by_id(
+    key: jax.Array, message: jnp.ndarray, ids, noise_var
+) -> jnp.ndarray:
+    """OMA corruption of a [k, d] stack keyed by STABLE client ids.
+
+    Service rounds draw a different participant subsample every iteration,
+    so "client i's channel" must mean population id ``ids[i]``, not stack
+    row i: each row's link realization is drawn from ``fold_in(key,
+    ids[i])``.  Two subsamples that both include a client therefore agree
+    on what its fade would be at a given round key, and the realization is
+    invariant to where the draw placed the client in the stack — which is
+    also what lets the streamed path apply the channel chunk-by-chunk
+    (pass the matching ``ids`` slice) and match the resident path bit-
+    for-bit.  Physics per row matches :func:`oma` exactly.
+    """
+    row_keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(ids)
+    return jax.vmap(_oma_row, in_axes=(0, 0, None))(
+        row_keys, message, noise_var
+    )
+
+
 def oma(key: jax.Array, message: jnp.ndarray, noise_var: float) -> jnp.ndarray:
     """Per-client orthogonal-link corruption of a [K, d] message stack.
 

@@ -268,3 +268,36 @@ def apply_transmission(
             n_erased = jnp.sum(erased).astype(jnp.float32)
 
     return w_stack, ge_bad, n_erased, n_corrupt
+
+
+def apply_deadline(
+    key: jax.Array, w_stack: jnp.ndarray, arrived, straggler_prob: float
+):
+    """Service-round deadline close: the round ends NOW with whatever
+    effective-K made it.
+
+    ``arrived`` is the [k] bool availability of the drawn participants at
+    draw time (a departed client was still drawn — the server scheduled
+    it — but its update never lands).  On top of that, each arrived row
+    independently misses the deadline with ``straggler_prob`` (static; 0
+    traces no bernoulli).  Missed rows are erased to NaN — the same
+    "nothing received" convention the fault channel uses, so the degraded
+    aggregators and effective-K accounting downstream apply unchanged.
+
+    Returns ``(w_stack, n_absent, n_late)`` with f32 scalar counts:
+    absent = drawn-but-offline, late = arrived but past deadline.
+    """
+    k = w_stack.shape[0]
+    if straggler_prob > 0.0:
+        late = jnp.logical_and(
+            arrived, jax.random.bernoulli(key, straggler_prob, (k,))
+        )
+    else:
+        late = jnp.zeros((k,), bool)
+    missed = jnp.logical_or(late, jnp.logical_not(arrived))
+    w_stack = jnp.where(
+        missed[:, None], jnp.asarray(jnp.nan, w_stack.dtype), w_stack
+    )
+    n_absent = jnp.sum(jnp.logical_not(arrived)).astype(jnp.float32)
+    n_late = jnp.sum(late).astype(jnp.float32)
+    return w_stack, n_absent, n_late

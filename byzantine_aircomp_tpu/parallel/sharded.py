@@ -137,6 +137,14 @@ class ShardedFedTrainer(FedTrainer):
             )
         if not isinstance(self.attack_iter, tuple):
             self.attack_iter = jax.device_put(self.attack_iter, repl)
+        if cfg.service == "on":
+            # service carry: [population] availability bools and the widen
+            # scalar replicate (the drawn [K] rows are gathered in-program,
+            # and every device must agree on the draw)
+            self.service_state = jax.tree.map(
+                lambda leaf: jax.device_put(leaf, repl), self.service_state
+            )
+            self._pop_shard = jax.device_put(self._pop_shard, repl)
         # server-opt state: [d]-shaped leaves follow the params layout,
         # scalars (e.g. adam's count) replicate
         self.server_opt_state = jax.tree.map(

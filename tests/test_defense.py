@@ -210,7 +210,8 @@ def test_config_hash_off_matches_predefense_formula():
     # fields existed, so they never entered the material (the same goes
     # for output-only knobs added since — profile_rounds/hbm_warn_factor
     # are excluded from the hash like every other obs knob, and the cohort
-    # streaming fields follow the same off-means-absent continuity contract)
+    # streaming / service-round fields follow the same off-means-absent
+    # continuity contract)
     skip = (
         "checkpoint_dir", "cache_dir", "profile_dir", "inherit", "rounds",
         "obs_dir", "obs_stdout", "log_file", "quiet",
@@ -221,6 +222,7 @@ def test_config_hash_off_matches_predefense_formula():
         for f in dataclasses.fields(cfg)
         if f.name not in skip + ("defense",) + FedConfig._DEFENSE_KNOBS
         + ("cohort_size",) + FedConfig._COHORT_KNOBS
+        + ("service",) + FedConfig._SERVICE_KNOBS
     )
     legacy = hashlib.sha256(repr(items).encode()).hexdigest()[:8]
     assert harness.config_hash(cfg) == legacy

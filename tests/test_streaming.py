@@ -212,6 +212,17 @@ def test_streamed_attack_parity():
         np.testing.assert_array_equal(streamed, resident, err_msg=attack)
 
 
+def test_streamed_partial_participation_matches_resident():
+    # subsample-then-stream: the drawn participant rows are chunked, so
+    # the streamed round replays the resident draw exactly (noiseless —
+    # streamed rounds re-key channel draws per cohort by design)
+    ds = _ds()
+    kw = dict(agg="median", participation=0.5)
+    resident = _final_params(_cfg(**kw), ds)
+    streamed = _final_params(_cfg(cohort_size=2, **kw), ds)
+    np.testing.assert_allclose(streamed, resident, atol=1e-4)
+
+
 def test_streamed_fault_round_runs_finite():
     ds = _ds()
     p = _final_params(
@@ -247,16 +258,19 @@ def test_cohort_zero_title_and_hash_continuity():
 
 def test_cohort_validation_errors():
     def invalid(match, **kw):
-        with pytest.raises(AssertionError, match=match):
+        with pytest.raises(ValueError, match=match):
             _cfg(**kw).validate()
 
     invalid("must divide", cohort_size=3)  # 3 does not divide honest_size=8
     invalid("no streaming", agg="krum", cohort_size=4)
     invalid("omniscient", byz_size=4, attack="alie", cohort_size=4)
     invalid("bucketing", cohort_size=4, bucket_size=2)
-    invalid("full participation", cohort_size=4, participation=0.5)
+    # partial participation streams fine when the cohort divides the
+    # PARTICIPATING stratified counts — and is rejected when it doesn't
+    invalid("must divide", cohort_size=4, participation=0.75)  # 6 % 4
     invalid("require --cohort-size", cohort_quantile="sketch")
     _cfg(cohort_size=4).validate()  # the happy path really is valid
+    _cfg(cohort_size=4, participation=0.5).validate()  # 4 participants
 
 
 # --------------------------------------------------- retrace / memory

@@ -168,6 +168,16 @@ def test_sweep_forwards_every_shared_knob():
         "cohort_size": 4,
         "cohort_quantile": "sketch",
         "cohort_sketch_bins": 256,
+        "service": "on",
+        "population": 24,
+        "churn_arrival": 0.05,
+        "churn_departure": 0.02,
+        "straggler_prob": 0.1,
+        "rollback": "off",
+        "rollback_loss_factor": 2.5,
+        "rollback_cusum": 2.0,
+        "rollback_widen": 2.0,
+        "rollback_max": 2,
     }
     # the fault knobs require --fault and full participation
     # (config.validate), so they ride a second, separate sweep cell;
@@ -177,6 +187,13 @@ def test_sweep_forwards_every_shared_knob():
                    "corrupt_prob", "corrupt_mode", "corrupt_size"}
     defense_dests = {d for d in samples if d.startswith("defense")}
     cohort_dests = {d for d in samples if d.startswith("cohort")}
+    # service knobs require --service on plus full participation, no
+    # fault/bucketing (config.validate), and rollback_cusum reads the
+    # defense CUSUM state — their cell rides with --defense monitor
+    service_dests = {"service", "population", "churn_arrival",
+                     "churn_departure", "straggler_prob", "rollback",
+                     "rollback_loss_factor", "rollback_cusum",
+                     "rollback_widen", "rollback_max"}
     probe = argparse.ArgumentParser()
     add_knob_flags(probe)
     flag_of = {
@@ -194,13 +211,17 @@ def test_sweep_forwards_every_shared_knob():
             "--rounds", "1", "--interval", "2", "--batch-size", "8"]
     orig = sweep_mod.run_sweep
     groups = (
-        set(flag_of) - fault_dests - defense_dests - cohort_dests,
+        set(flag_of) - fault_dests - defense_dests - cohort_dests
+        - service_dests,
         fault_dests,
         defense_dests,
         cohort_dests,
+        service_dests,
     )
     for group in groups:
         argv = list(base)
+        if group is service_dests:
+            argv += ["--defense", "monitor"]
         for dest in sorted(group):
             argv += [flag_of[dest], str(samples[dest])]
 
