@@ -1,36 +1,58 @@
-"""Adaptive-defense matrix: every registered attack against the online
-defense at the stack level.
+"""Attack x defense break-matrix: every registered attack — the static
+stack-level ones AND the defense-aware adaptive tier — against every
+defense mode and ladder variant.
 
 The fault matrix (:mod:`.fault_matrix`) asks "which aggregator survives
 which failure"; this tool asks the DEFENSE question the escalation ladder
-adds: for each registered attack — switched on mid-run through the
-``name@R`` onset syntax and optionally switched back off — does the
-detector notice (and how fast), does the policy climb the ladder, and does
-it climb back down once the attacker goes quiet?  Cells run the real
-``defense/`` scoring + policy math on a small synthetic stack (the
+adds: for each registered attack, does the detector notice (and how
+fast), how long does the policy consider the run suspicious, does it
+climb the ladder, does it climb back down once the attacker goes quiet —
+and, for the adaptive attackers, does the evasion/persistence trick the
+attack was built around actually work?  Cells run the real ``defense/``
+scoring + policy math on a small synthetic stack (the
 ``tests/test_defense_matrix.py`` regime: a tight honest cluster one SGD
 step apart), so the whole matrix is seconds, not training runs:
 
     python -m byzantine_aircomp_tpu.analysis.adaptive_matrix \
-        --modes monitor,adaptive --iters 40 --onset 10 --stop 30
+        --modes off,monitor,adaptive --iters 40 --onset 10 --stop 30 \
+        --ladders "mean,trimmed_mean,multi_krum;mean,bev,multi_krum"
+
+Semantics mirrored from the trainer (fed/train.py):
+
+* the attack runs BEFORE the iteration's detector update, so a
+  defense-aware attack observes the PREVIOUS iteration's published
+  detector state (:class:`ops.attacks.DefenseView`);
+* ``duty_cycle`` schedules itself off the policy constants — its cells
+  force ``onset=0, stop=None`` and stretch the horizon to at least two
+  full burst/sleep periods so the between-burst floor is observable;
+* ``mode=off`` has no detector, so defense-aware attacks (which need
+  published state to observe) and the detection columns are ``skipped``;
+* data-level attacks whose ``apply_message`` leaves the stack untouched
+  and that carry no gradient-scale emulation are marked ``skipped``
+  explicitly — a dash in the latency column would read as "ran and went
+  undetected" when the cell never had a stack-level signature to find.
 
 Output: one JSON line per cell on stdout (kind ``adaptive_cell``), a
-markdown table per mode on stderr, and optionally an atomic pickle of the
-grid (``--out``).  Data-level attacks (whose ``apply_message`` leaves the
-stack untouched) are emulated through their gradient scale when they have
-one; pure data-poisoning attacks legitimately show no stack-level anomaly
-and report ``detect_iter = None``.
+markdown table per (mode, ladder) on stderr, optionally an atomic pickle
+of the grid (``--out``) and a canonical timestamp-free JSON dump
+(``--json``) whose bytes are a pure function of the flags + ``--seed`` —
+commit two of them and ``diff`` shows exactly which cells moved.
+``--assert-smoke`` turns the matrix into a CI gate: at least one
+adaptive-mode cell of a defense-aware attack must detect, and the
+``duty_cycle`` adaptive cell must stay escalated between bursts
+(``min_rung_post >= 1`` — the leaky-budget floor, ``--floor 0`` restores
+the seed hysteresis for before/after comparisons).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from .. import defense as defense_lib
 from .. import obs as obs_lib
@@ -41,7 +63,9 @@ from ..utils import io as io_lib
 K, B, D = 16, 3, 24
 HONEST = K - B
 
-Cell = Tuple[str, str]  # (attack, mode)
+MODES = ("off", "monitor", "adaptive")
+
+Cell = Tuple[str, str, str]  # (attack, mode, ladder)
 
 
 def honest_stack(key: Optional[jax.Array] = None):
@@ -58,15 +82,19 @@ def honest_stack(key: Optional[jax.Array] = None):
     return w.astype(jnp.float32), base.astype(jnp.float32)
 
 
-def _attacked(spec, w, base, key):
+def _attacked(spec, w, base, key, defense=None):
     """The transmitted stack under ``spec``: the message attack where it
     acts, else the gradient-scale emulation (a scaled deviation from the
     global params is exactly what a scaled gradient sends)."""
-    w_att = spec.apply_message(w, B, key)
+    w_att = spec.apply_message(w, B, key, defense=defense)
     if spec.grad_scale != 1.0 and bool(jnp.all(w_att == w)):
         dev = w[-B:] - base[None, :]
         w_att = w.at[-B:].set(base[None, :] + spec.grad_scale * dev)
     return w_att
+
+
+def _skip(reason: str) -> Dict[str, object]:
+    return {"skipped": reason}
 
 
 def simulate_cell(
@@ -84,16 +112,48 @@ def simulate_cell(
     """One (attack, mode) cell: the defense loop run eagerly for ``iters``
     iterations with the attack active on ``[onset, stop)``.
 
-    Reports detection latency relative to onset, the rung trajectory
-    (max/final/transitions), whether the policy de-escalated after the
-    attacker went quiet, and — under ``adaptive`` — the final aggregate's
-    distance from the honest centroid (the number a successful escalation
-    must keep small while the attack runs)."""
+    Reports detection latency relative to onset (``detect_iter``), how
+    many iterations the policy called suspicious (``rounds_suspicious``),
+    the rung trajectory (max/final/transitions), the minimum rung AFTER
+    the first time the max was reached (``min_rung_post`` — the
+    duty-cycle floor question: 0 means the ladder fully relaxed while the
+    attacker was merely sleeping), whether the policy de-escalated after
+    the attacker went quiet, the worst in-attack aggregate error
+    (``agg_err``), and the final aggregate's distance to the honest mean
+    of the last transmitted stack (``final_dist`` — the number the paper's
+    receiver ultimately cares about).  Skipped cells return
+    ``{"skipped": reason}`` instead of fabricating a quiet row."""
     spec = attack_lib.resolve(attack_name)
+    meta = spec.meta()
+    if mode == "off" and meta["defense_aware"]:
+        return _skip(
+            "defense-aware attack observes the published detector state; "
+            "--defense off publishes none (fed/config.py rejects the "
+            "combination for real runs too)"
+        )
+    if meta["data_level"] and spec.grad_scale == 1.0:
+        return _skip(
+            "data-level attack leaves the transmitted stack untouched "
+            "(no stack-level signature exists; see fault/attack tiers "
+            "in DESIGN.md)"
+        )
     det = det or defense_lib.DetectorParams()
+    # min_flagged=2: a burst from the B=3 attackers flags all three rows,
+    # while a singleton honest z-spike (the tight synthetic cluster drives
+    # dev near zero, so noise occasionally crosses z_thresh) must not
+    # count as suspicious — it would reset the de-escalation streak and
+    # mask the hysteresis behavior the duty-cycle cells measure
     pol = pol or defense_lib.PolicyParams(
-        up_n=3, down_m=8, n_rungs=len(ladder)
+        up_n=3, down_m=8, n_rungs=len(ladder), min_flagged=2
     )
+    self_sched = attack_name.split("@")[0] == "duty_cycle"
+    if self_sched:
+        # the attack times itself off the policy constants: start at 0,
+        # never "stop", and run >= two full periods so the between-burst
+        # window (where the seed ladder fully relaxed) is in frame
+        on_p, period = attack_lib.duty_cycle_schedule(pol)
+        onset, stop = 0, None
+        iters = max(iters, 2 * period + on_p)
     branches = defense_lib.make_branch_table(
         ladder, honest_size=HONEST, impl="xla", maxiter=50, tol=1e-5,
         clip_iters=3,
@@ -103,40 +163,78 @@ def simulate_cell(
     d_state = defense_lib.init_detector(K)
     p_state = defense_lib.init_policy()
     detect_iter = None
+    rounds_susp = 0
     max_rung = 0
     transitions = 0
     prev_rung = 0
     rung_at_stop = 0
+    max_seen_at = None          # first iteration the max rung was reached
+    min_rung_post = None
     agg_err = None
+    final_dist = None
     for t in range(iters):
         kt = jax.random.fold_in(key0, 100 + t)
         w = base[None, :] + 1e-3 * jax.random.normal(kt, (K, D))
         w = w.astype(jnp.float32)
         active = onset <= t and (stop is None or t < stop)
         if active:
-            w = _attacked(spec, w, base, jax.random.fold_in(key0, 200 + t))
-        score, finite = defense_lib.client_scores(w, base)
-        d_state, flags = defense_lib.detector_update(d_state, score, finite, det)
-        p_state, _ = defense_lib.policy_update(p_state, jnp.sum(flags), pol)
-        rung = int(p_state[0])
-        if detect_iter is None and active and int(jnp.sum(flags)) > 0:
-            detect_iter = t - onset
-        max_rung = max(max_rung, rung)
+            d_view = None
+            if meta["defense_aware"]:
+                # trainer semantics: the attack observes the PREVIOUS
+                # iteration's published state (it runs pre-update)
+                d_view = attack_lib.DefenseView(
+                    step=d_state[0], ema=d_state[1], dev=d_state[2],
+                    cusum=d_state[3], rung=p_state[0],
+                    detector=det, policy=pol, guess=base,
+                )
+            w = _attacked(
+                spec, w, base, jax.random.fold_in(key0, 200 + t),
+                defense=d_view,
+            )
+        if mode == "off":
+            rung = 0
+        else:
+            score, finite = defense_lib.client_scores(w, base)
+            d_state, flags = defense_lib.detector_update(
+                d_state, score, finite, det
+            )
+            p_state, susp = defense_lib.policy_update(
+                p_state, jnp.sum(flags), pol
+            )
+            rung = int(p_state[0])
+            rounds_susp += int(bool(susp))
+            if detect_iter is None and active and int(jnp.sum(flags)) > 0:
+                detect_iter = t - onset
+        if rung > max_rung:
+            max_rung, max_seen_at = rung, t
         transitions += int(rung != prev_rung)
         prev_rung = rung
         if stop is not None and t == stop - 1:
             rung_at_stop = rung
-        if mode == "adaptive":
-            agg = branches[rung]((w, base, jax.random.fold_in(key0, 300 + t)))
-            if active:
-                agg_err = float(jnp.linalg.norm(agg - base))
+        if max_seen_at is not None and t > max_seen_at:
+            min_rung_post = (
+                rung if min_rung_post is None else min(min_rung_post, rung)
+            )
+        act_rung = rung if mode == "adaptive" else 0
+        agg = branches[act_rung](
+            (w, base, jax.random.fold_in(key0, 300 + t))
+        )
+        if active:
+            agg_err = float(jnp.linalg.norm(agg - base))
+        if t == iters - 1:
+            final_dist = float(
+                jnp.linalg.norm(agg - jnp.mean(w[:HONEST], axis=0))
+            )
     final_rung = int(p_state[0])
     cell: Dict[str, object] = {
         "detect_iter": detect_iter,
+        "rounds_suspicious": rounds_susp,
         "max_rung": max_rung,
+        "min_rung_post": min_rung_post,
         "final_rung": final_rung,
         "transitions": transitions,
         "deescalated": stop is not None and final_rung < rung_at_stop,
+        "final_dist": round(final_dist, 5),
     }
     if agg_err is not None:
         cell["agg_err"] = round(agg_err, 5)
@@ -146,62 +244,140 @@ def simulate_cell(
 def run_matrix(
     attacks: List[str],
     modes: List[str],
+    ladders: Optional[List[Tuple[str, ...]]] = None,
     log=lambda s: print(s, file=sys.stderr, flush=True),
     on_cell=None,
     **sim_kw,
 ) -> Dict[Cell, Dict[str, object]]:
     for a in attacks:
         attack_lib.resolve(a)  # fail fast on typos (onset syntax included)
+    if ladders is None:
+        ladders = [sim_kw.pop("ladder", ("mean", "trimmed_mean",
+                                         "multi_krum"))]
+    else:
+        sim_kw.pop("ladder", None)
+    for m in modes:
+        if m not in MODES:
+            raise ValueError(f"unknown mode {m!r}; pick from {MODES}")
     grid: Dict[Cell, Dict[str, object]] = {}
-    for mode in modes:
-        for attack in attacks:
-            cell = simulate_cell(attack, mode, **sim_kw)
-            grid[(attack, mode)] = cell
-            log(f"[adaptive_matrix] attack={attack} mode={mode}: {cell}")
-            if on_cell is not None:
-                on_cell(attack, mode, cell)
+    for lad in ladders:
+        lad_name = ",".join(lad)
+        for mode in modes:
+            for attack in attacks:
+                cell = simulate_cell(attack, mode, ladder=lad, **sim_kw)
+                grid[(attack, mode, lad_name)] = cell
+                log(
+                    f"[adaptive_matrix] attack={attack} mode={mode} "
+                    f"ladder={lad_name}: {cell}"
+                )
+                if on_cell is not None:
+                    on_cell(attack, mode, lad_name, cell)
     return grid
 
 
 def markdown_table(grid: Dict[Cell, Dict[str, object]]) -> str:
-    """One ``attack x metric`` table per mode; undetected cells show ``-``
-    in the latency column so a silent attack can't read as instant."""
-    modes = sorted({m for _, m in grid})
-    attacks = sorted({a for a, _ in grid})
+    """One ``attack x metric`` table per (mode, ladder); undetected cells
+    show ``-`` in the latency column so a silent attack can't read as
+    instant, and skipped cells say so instead of faking a quiet row."""
+    groups = sorted({(m, l) for _, m, l in grid})
+    attacks = sorted({a for a, _, _ in grid})
     blocks = []
-    for m in modes:
+    for m, lad in groups:
         head = (
-            f"**mode: {m}**\n\n| attack | detect_lat | max_rung | "
-            f"final_rung | deescalated |"
+            f"**mode: {m} | ladder: {lad}**\n\n| attack | detect_lat | "
+            f"susp | max_rung | min_post | final_rung | deesc | "
+            f"final_dist |"
         )
-        sep = "|---|---|---|---|---|"
+        sep = "|---|---|---|---|---|---|---|---|"
         rows = []
         for a in attacks:
-            c = grid[(a, m)]
+            c = grid[(a, m, lad)]
+            if "skipped" in c:
+                rows.append(f"| {a} | skipped | | | | | | |")
+                continue
             lat = "-" if c["detect_iter"] is None else str(c["detect_iter"])
+            post = (
+                "-" if c["min_rung_post"] is None
+                else str(c["min_rung_post"])
+            )
             rows.append(
-                f"| {a} | {lat} | {c['max_rung']} | {c['final_rung']} | "
-                f"{c['deescalated']} |"
+                f"| {a} | {lat} | {c['rounds_suspicious']} | "
+                f"{c['max_rung']} | {post} | {c['final_rung']} | "
+                f"{c['deescalated']} | {c['final_dist']} |"
             )
         blocks.append("\n".join([head, sep] + rows))
     return "\n\n".join(blocks)
+
+
+def assert_smoke(grid: Dict[Cell, Dict[str, object]]) -> None:
+    """The CI acceptance gate (``--assert-smoke``): the defense-aware tier
+    must be exercised, at least one adaptive-mode cell of a defense-aware
+    attack must detect, and the duty-cycle cell must stay escalated
+    between bursts (the leaky-budget floor)."""
+    aware = [
+        (k, c) for k, c in grid.items()
+        if k[1] == "adaptive" and "skipped" not in c
+        and attack_lib.resolve(k[0]).meta()["defense_aware"]
+    ]
+    if not aware:
+        raise SystemExit(
+            "[adaptive_matrix] smoke: no defense-aware adaptive cells ran"
+        )
+    if not any(c["detect_iter"] is not None for _, c in aware):
+        raise SystemExit(
+            "[adaptive_matrix] smoke: no defense-aware attack was ever "
+            "detected in adaptive mode — the detector lost every cell"
+        )
+    duty = [
+        c for (a, m, _), c in grid.items()
+        if a.split("@")[0] == "duty_cycle" and m == "adaptive"
+    ]
+    if not duty:
+        raise SystemExit(
+            "[adaptive_matrix] smoke: no duty_cycle adaptive cell in the "
+            "grid (pass --attacks including duty_cycle)"
+        )
+    for c in duty:
+        if c.get("min_rung_post") is None or c["min_rung_post"] < 1:
+            raise SystemExit(
+                "[adaptive_matrix] smoke: duty_cycle cell fully "
+                f"de-escalated between bursts ({c}) — the hysteresis "
+                "floor regressed"
+            )
+    print("[adaptive_matrix] smoke assertions passed", file=sys.stderr)
 
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--attacks", default=None,
                     help="comma list; default: every registered attack")
-    ap.add_argument("--modes", default="monitor,adaptive")
+    ap.add_argument("--modes", default="off,monitor,adaptive")
     ap.add_argument("--iters", type=int, default=40)
     ap.add_argument("--onset", type=int, default=10,
                     help="iteration the attack switches ON")
     ap.add_argument("--stop", type=int, default=30,
                     help="iteration the attack switches OFF (-1: never)")
     ap.add_argument("--ladder", default="mean,trimmed_mean,multi_krum")
-    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ladders", default=None,
+                    help="semicolon-separated ladder variants (each a "
+                         "comma list); overrides --ladder")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="PRNG seed for every cell; cells are a pure "
+                         "function of (flags, seed) for cross-PR diffing")
+    ap.add_argument("--floor", type=float, default=1.5,
+                    help="policy floor_thresh (0 = the seed hysteresis, "
+                         "for before/after comparisons)")
+    ap.add_argument("--leak", type=float, default=0.005,
+                    help="policy budget_leak")
     ap.add_argument("--out", default=None, help="pickle the grid here")
+    ap.add_argument("--json", default=None,
+                    help="canonical sorted timestamp-free JSON dump here "
+                         "(committed artifacts diff cleanly)")
     ap.add_argument("--obs-dir", default=None,
                     help="also append adaptive_cell events (JSONL) here")
+    ap.add_argument("--assert-smoke", action="store_true",
+                    help="exit nonzero unless a defense-aware cell "
+                         "detects and duty_cycle stays escalated")
     args = ap.parse_args(argv)
 
     attacks = (
@@ -210,6 +386,11 @@ def main(argv=None) -> None:
         else sorted(ATTACKS.names())
     )
     modes = [m for m in args.modes.split(",") if m]
+    ladders = [
+        tuple(n for n in lad.split(",") if n)
+        for lad in (args.ladders or args.ladder).split(";")
+        if lad
+    ]
     sinks = [obs_lib.StdoutSink()]
     if args.obs_dir:
         sinks.append(
@@ -218,18 +399,30 @@ def main(argv=None) -> None:
             )
         )
     sink = obs_lib.MultiSink(sinks) if len(sinks) > 1 else sinks[0]
+    n_rungs = {len(lad) for lad in ladders}
+    if len(n_rungs) != 1:
+        raise SystemExit(
+            "[adaptive_matrix] ladder variants must share a length (the "
+            f"policy is sized once per run): {sorted(n_rungs)}"
+        )
+    pol = defense_lib.PolicyParams(
+        up_n=3, down_m=8, n_rungs=n_rungs.pop(), min_flagged=2,
+        budget_leak=args.leak, floor_thresh=args.floor,
+    )
     try:
         grid = run_matrix(
             attacks,
             modes,
+            ladders=ladders,
             iters=args.iters,
             onset=args.onset,
             stop=None if args.stop < 0 else args.stop,
-            ladder=tuple(n for n in args.ladder.split(",") if n),
+            pol=pol,
             seed=args.seed,
-            on_cell=lambda attack, mode, cell: sink.emit(
+            on_cell=lambda attack, mode, lad, cell: sink.emit(
                 obs_lib.make_event(
-                    "adaptive_cell", attack=attack, mode=mode, **cell
+                    "adaptive_cell", attack=attack, mode=mode,
+                    ladder=lad, **cell
                 )
             ),
         )
@@ -238,9 +431,19 @@ def main(argv=None) -> None:
     print(markdown_table(grid), file=sys.stderr, flush=True)
     if args.out:
         io_lib.atomic_pickle(
-            args.out, {f"{a}|{m}": c for (a, m), c in grid.items()}
+            args.out, {"|".join(k): c for k, c in grid.items()}
         )
         print(f"[adaptive_matrix] grid pickled to {args.out}", file=sys.stderr)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(
+                {"|".join(k): c for k, c in grid.items()},
+                f, sort_keys=True, indent=1,
+            )
+            f.write("\n")
+        print(f"[adaptive_matrix] grid dumped to {args.json}", file=sys.stderr)
+    if args.assert_smoke:
+        assert_smoke(grid)
 
 
 if __name__ == "__main__":

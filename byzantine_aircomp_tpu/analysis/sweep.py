@@ -219,6 +219,8 @@ def main(argv=None) -> None:
         defense_up=args.defense_up,
         defense_down=args.defense_down,
         defense_min_flagged=args.defense_min_flagged,
+        defense_floor=args.defense_floor,
+        defense_leak=args.defense_leak,
         cohort_size=args.cohort_size,
         cohort_quantile=args.cohort_quantile,
         cohort_sketch_bins=args.cohort_sketch_bins,

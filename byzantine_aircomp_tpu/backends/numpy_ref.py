@@ -426,3 +426,141 @@ def minsum(w: np.ndarray, byz_size: int, gamma: Optional[float] = None) -> np.nd
         )
     out[-byz_size:] = row
     return out
+
+
+def bev(
+    w: np.ndarray,
+    guess: np.ndarray,
+    sign_eta: Optional[float] = None,
+) -> np.ndarray:
+    """Oracle for the framework's best-effort-voting rung (an extension;
+    BEV-SGD, arXiv:2110.09660): new = guess + eta * sign(sum_i
+    sign(w_i - guess)), equal-weight per-coordinate ballots.  eta =
+    sign_eta or the coordinatewise LOWER-MIDDLE median of |w_i - guess|
+    with non-finite deltas counted as +Inf (an Inf median degrades the
+    coordinate to a no-op step), matching the jax path."""
+    delta = w - guess[None, :]
+    finite = np.isfinite(delta)
+    votes = np.where(finite, np.sign(delta), 0.0).sum(axis=0)
+    if sign_eta is None:
+        absd = np.where(finite, np.abs(delta), np.inf)
+        eta = np.sort(absd, axis=0)[(len(w) - 1) // 2]
+        eta = np.where(np.isfinite(eta), eta, 0.0)
+    else:
+        eta = np.float32(sign_eta)
+    return (guess + eta * np.sign(votes)).astype(np.float32)
+
+
+def _masked_median(x: np.ndarray, mask: np.ndarray) -> float:
+    srt = np.sort(np.where(mask, x, np.inf))
+    return float(srt[max(int(mask.sum()) - 1, 0) // 2])
+
+
+def defense_client_scores(
+    w: np.ndarray, guess: np.ndarray
+) -> "tuple[np.ndarray, np.ndarray]":
+    """Oracle for ``defense/scores.client_scores``: per-client composite
+    anomaly score (relative norm excess + direction disagreement +
+    pairwise-distance excess), medians/centroid over finite rows only,
+    non-finite rows scoring exactly 0."""
+    finite = np.isfinite(w).all(axis=1)
+    delta = (w - guess[None, :]).astype(np.float32)
+    safe = np.where(finite[:, None], delta, 0.0)
+    norms = np.sqrt((safe * safe).sum(axis=1))
+    med_norm = _masked_median(norms, finite)
+    norm_term = np.maximum(norms / max(med_norm, 1e-12) - 1.0, 0.0)
+    cent = safe.sum(axis=0) / max(int(finite.sum()), 1)
+    cent_norm = np.sqrt((cent * cent).sum())
+    cos = (safe * cent[None, :]).sum(axis=1) / (
+        np.maximum(norms, 1e-12) * max(cent_norm, 1e-12)
+    )
+    cos_term = np.maximum(1.0 - cos, 0.0)
+    diff = w[:, None, :] - w[None, :, :]
+    dists = (diff * diff).sum(axis=-1)
+    pair_mask = finite[None, :] & ~np.eye(len(w), dtype=bool)
+    n_others = np.maximum(pair_mask.sum(axis=1), 1)
+    dist_mean = np.where(pair_mask, dists, 0.0).sum(axis=1) / n_others
+    med_dist = _masked_median(dist_mean, finite)
+    dist_term = np.maximum(dist_mean / max(med_dist, 1e-12) - 1.0, 0.0)
+    score = np.where(finite, norm_term + cos_term + dist_term, 0.0)
+    return score.astype(np.float32), finite
+
+
+def mimic(
+    w: np.ndarray, byz_size: int, ema: np.ndarray, cusum: np.ndarray
+) -> np.ndarray:
+    """Oracle for the framework's mimic attack (an extension; the ByzFL
+    taxonomy's replay attacker): every Byzantine row replays the honest
+    client the detector currently trusts most (minimal CUSUM, EMA as the
+    tie-break)."""
+    out = w.copy()
+    honest = w[:-byz_size]
+    h = len(honest)
+    tgt = int(np.argmin(cusum[:h] + 1e-3 * ema[:h]))
+    out[-byz_size:] = honest[tgt]
+    return out
+
+
+def under_radar(
+    w: np.ndarray,
+    byz_size: int,
+    step: int,
+    ema: np.ndarray,
+    dev: np.ndarray,
+    cusum: np.ndarray,
+    guess: np.ndarray,
+    *,
+    alpha: float = 0.1,
+    drift: float = 0.5,
+    z_thresh: float = 4.0,
+    cusum_thresh: float = 8.0,
+    warmup: int = 5,
+    clip: float = 3.0,
+    eps: float = 1e-6,
+    margin: float = 0.9,
+    iters: int = 25,
+) -> np.ndarray:
+    """Oracle for the framework's under-the-radar attack (an extension):
+    fixed-count bisection on the push distance gamma along the steered
+    ALIE/IPM direction, landing the Byzantine rows' NEXT detector scores
+    just under margin * the flag thresholds (instantaneous z AND the
+    would-be CUSUM).  During detector warmup the constraint is vacuous
+    and gamma runs to the top of the bracket."""
+    honest = w[:-byz_size]
+    mu = honest.mean(axis=0)
+    sig = honest.std(axis=0)
+    mu_n = max(np.linalg.norm(mu), 1e-12)
+    sig_n = max(np.linalg.norm(sig), 1e-12)
+    u = -(mu / mu_n + sig / sig_n)
+    u = u / max(np.linalg.norm(u), 1e-12)
+    warm = step >= warmup
+
+    def stack_at(gamma):
+        out = w.copy()
+        out[-byz_size:] = mu + gamma * u
+        return out
+
+    def ok(gamma):
+        if not warm:
+            return True
+        score, _ = defense_client_scores(stack_at(gamma), guess)
+        z = (score - ema) / (dev + eps)
+        cus = np.minimum(
+            np.maximum(cusum + np.clip(z, -clip, clip) - drift, 0.0),
+            2.0 * cusum_thresh,
+        )
+        return bool(
+            (z[-byz_size:] <= margin * z_thresh).all()
+            and (cus[-byz_size:] <= margin * cusum_thresh).all()
+        )
+
+    diff = honest[:, None, :] - honest[None, :, :]
+    pair = (diff * diff).sum(axis=-1)
+    lo, hi = 0.0, float(2.0 * (mu_n + sig_n) + np.sqrt(pair.max()))
+    for _ in range(iters):
+        mid = 0.5 * (lo + hi)
+        if ok(mid):
+            lo = mid
+        else:
+            hi = mid
+    return stack_at(lo)

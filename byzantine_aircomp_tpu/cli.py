@@ -132,6 +132,12 @@ def add_knob_flags(p) -> None:
                    help="consecutive clean iterations per de-escalation")
     p.add_argument("--defense-min-flagged", type=int, default=1,
                    help="flagged clients that make an iteration suspicious")
+    p.add_argument("--defense-floor", type=float, default=1.5,
+                   help="leaky escalation-budget threshold above which the "
+                        "rung floor pins at 1 (duty-cycle resistance; "
+                        "0 disables the floor)")
+    p.add_argument("--defense-leak", type=float, default=0.005,
+                   help="per-iteration decay rate of the escalation budget")
     # service-round surface (fed/train.py); knob flags require --service on
     p.add_argument("--service", choices=["off", "on"], default="off",
                    help="always-on service rounds: draw each round's K "
@@ -214,6 +220,8 @@ ARG_TO_FIELD = {
     "defense_up": ("defense_up", None),
     "defense_down": ("defense_down", None),
     "defense_min_flagged": ("defense_min_flagged", None),
+    "defense_floor": ("defense_floor", None),
+    "defense_leak": ("defense_leak", None),
     "service": ("service", None),
     "population": ("population", None),
     "churn_arrival": ("churn_arrival", None),

@@ -76,6 +76,8 @@ def from_config(cfg) -> "DefenseSpec | None":
             down_m=cfg.defense_down,
             min_flagged=cfg.defense_min_flagged,
             n_rungs=len(ladder),
+            budget_leak=cfg.defense_leak,
+            floor_thresh=cfg.defense_floor,
         ),
     )
 

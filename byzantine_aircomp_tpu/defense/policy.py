@@ -12,6 +12,19 @@ escalate one rung (streak resets, so climbing the whole ladder takes
 expensive defense); ``down_m`` consecutive clean iterations de-escalate
 one rung.  Either counter resets on the opposite observation.
 
+Duty-cycle resistance (the break-matrix fix): pure streak hysteresis is
+breakable by an attacker that bursts, sleeps exactly through the
+de-escalation window, and repeats (``ops/attacks.duty_cycle`` probes
+precisely this) — every burst restarts against the cheapest rung.  The
+policy therefore carries a LEAKY ESCALATION BUDGET: each escalation adds
+one unit, the budget decays by ``budget_leak`` per iteration, and while
+it sits above ``floor_thresh`` the rung cannot de-escalate below 1.  A
+single transient escalation (budget ~1) decays away without ever
+tripping the floor; repeated escalations integrate faster than the leak
+drains, so a duty-cycled attacker finds the ladder still raised when the
+next burst lands.  ``floor_thresh <= 0`` disables the floor (the seed
+behavior, kept reachable for before/after matrix cells).
+
 In ``adaptive`` mode the active rung picks the aggregator through
 ``lax.switch`` over a static table of closures built from the registry —
 branchless on-device dispatch, no host involvement, no retrace when the
@@ -38,7 +51,7 @@ import jax.numpy as jnp
 
 from ..registry import AGGREGATORS
 
-#: policy carry: (rung i32, up_streak i32, down_streak i32)
+#: policy carry: (rung i32, up_streak i32, down_streak i32, budget f32)
 PolicyState = tuple
 
 
@@ -50,29 +63,43 @@ class PolicyParams:
     down_m: int = 20       # consecutive clean iterations per de-escalation
     min_flagged: int = 1   # flagged clients that make an iteration suspicious
     n_rungs: int = 3       # ladder length (clamps the rung)
+    # leaky escalation budget (duty-cycle resistance, module docstring):
+    # +1 per escalation, *(1 - budget_leak) per iteration; budget above
+    # floor_thresh pins the rung floor at 1.  floor_thresh <= 0 disables.
+    budget_leak: float = 0.005
+    floor_thresh: float = 1.5
 
 
 def init_policy() -> PolicyState:
-    return (jnp.int32(0), jnp.int32(0), jnp.int32(0))
+    return (jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.float32(0.0))
 
 
 def policy_update(pol: PolicyState, n_flagged, p: PolicyParams):
     """One hysteresis step; returns ``(new_state, suspicious bool)``."""
-    rung, up, down = pol
+    rung, up, down, budget = pol
     suspicious = n_flagged >= p.min_flagged
     up = jnp.where(suspicious, up + 1, 0)
     down = jnp.where(suspicious, 0, down + 1)
     escalate = up >= p.up_n
     deescalate = (down >= p.down_m) & (rung > 0)
+    # escalation-history budget: integrates escalations, leaks per step;
+    # above the threshold the floor keeps one rung of caution in place
+    # however long the attacker sleeps
+    budget = budget * (1.0 - p.budget_leak) + escalate.astype(jnp.float32)
+    if p.floor_thresh > 0:
+        floor = (budget >= p.floor_thresh).astype(jnp.int32)
+        floor = jnp.minimum(floor, p.n_rungs - 1)
+    else:
+        floor = jnp.int32(0)
     rung = jnp.clip(
         rung + escalate.astype(jnp.int32) - deescalate.astype(jnp.int32),
-        0,
+        floor,
         p.n_rungs - 1,
     )
     # a consumed streak restarts: each further rung needs fresh evidence
     up = jnp.where(escalate, 0, up)
     down = jnp.where(deescalate, 0, down)
-    return (rung, up, down), suspicious
+    return (rung, up, down, budget), suspicious
 
 
 def validate_ladder(names: Sequence[str], base_agg: "str | None") -> None:

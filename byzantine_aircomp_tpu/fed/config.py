@@ -104,6 +104,13 @@ class FedConfig:
     defense_up: int = 3
     defense_down: int = 20
     defense_min_flagged: int = 1
+    # duty-cycle resistance (defense/policy.py): each escalation adds one
+    # unit to a leaky budget (decaying by defense_leak per iteration);
+    # budget >= defense_floor pins the de-escalation floor at rung 1, so
+    # a burst/sleep/burst attacker (ops/attacks.duty_cycle) finds the
+    # ladder still raised.  defense_floor = 0 disables (seed hysteresis)
+    defense_floor: float = 1.5
+    defense_leak: float = 0.005
 
     # aggregator options (reference options dict, :350)
     agg_maxiter: int = 1000
@@ -359,7 +366,7 @@ class FedConfig:
     _DEFENSE_KNOBS = (
         "defense_ladder", "defense_warmup", "defense_alpha", "defense_drift",
         "defense_cusum", "defense_z", "defense_up", "defense_down",
-        "defense_min_flagged",
+        "defense_min_flagged", "defense_floor", "defense_leak",
     )
 
     # cohort knobs that require cohort_size > 0 (fault-knob contract);
@@ -576,6 +583,14 @@ class FedConfig:
                 f"up={self.defense_up}, down={self.defense_down}, "
                 f"min_flagged={self.defense_min_flagged}"
             )
+            assert self.defense_floor >= 0.0, (
+                f"defense_floor must be >= 0 (0 disables the escalation-"
+                f"budget rung floor), got {self.defense_floor}"
+            )
+            assert 0.0 <= self.defense_leak < 1.0, (
+                f"defense_leak must be in [0, 1) (per-iteration budget "
+                f"decay), got {self.defense_leak}"
+            )
             # ladder resolution fails here, not at trace time; in adaptive
             # mode rung 0 must be the configured aggregator
             from ..defense.policy import validate_ladder
@@ -584,6 +599,23 @@ class FedConfig:
                 self.defense_ladder_names(),
                 self.agg if self.defense == "adaptive" else None,
             )
+        if self.attack is not None:
+            # knowledge-tier contract (AttackSpec.meta()): a defense-aware
+            # attack observes the carried detector state, which only
+            # exists when the defense subsystem is running
+            from ..ops import attacks as attack_lib
+
+            if (
+                attack_lib.resolve(self.attack).meta()["defense_aware"]
+                and self.defense == "off"
+            ):
+                raise ValueError(
+                    f"attack {self.attack!r} is defense-aware (it reads "
+                    f"the published detector EMA/CUSUM state inside the "
+                    f"round) and requires --defense adaptive|monitor; "
+                    f"with --defense off there is no detector state to "
+                    f"observe"
+                )
         if self.cohort_size < 0:
             raise ValueError(
                 f"cohort_size must be >= 0, got {self.cohort_size}"
@@ -666,14 +698,15 @@ class FedConfig:
             if self.attack is not None:
                 from ..ops import attacks as attack_lib
 
-                spec = attack_lib.resolve(self.attack)
-                if not attack_lib.streamable(spec):
+                meta = attack_lib.resolve(self.attack).meta()
+                if not meta["streamable"]:
                     raise ValueError(
                         f"attack {self.attack!r} is omniscient (reads the "
                         f"honest rows of the resident stack) and cannot "
                         f"run under cohort streaming; row-local/data-level "
-                        f"attacks (signflip, gaussian, classflip, "
-                        f"dataflip, gradascent) stream fine"
+                        f"attacks (signflip, gaussian, duty_cycle, "
+                        f"classflip, dataflip, gradascent) stream fine — "
+                        f"use --cohort-size 0 for the omniscient ones"
                     )
             if self.fault is not None:
                 from ..ops import faults as fault_lib

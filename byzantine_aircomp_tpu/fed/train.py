@@ -685,8 +685,34 @@ class FedTrainer:
             # attack_param BEFORE its no-op early-out, so a bogus knob
             # fails loudly (ops/attacks.py) instead of being ignored
             if self.attack is not None:
+                d_view = None
+                if self.attack.defense_aware:
+                    # defense-aware tier: the attacker reads the detector
+                    # state the server PUBLISHED after the previous
+                    # iteration (the attack runs before this iteration's
+                    # defense_score, so the carry still holds it).  Under
+                    # --service the [population] baselines are gathered to
+                    # the drawn slate so row i describes stack row i —
+                    # the same alignment detector_update writes back.
+                    det_a, pol_a = defense_state
+                    step_a, ema_a, dev_a, cus_a = det_a
+                    if cfg.service == "on":
+                        ema_a = ema_a[pop_ids]
+                        dev_a = dev_a[pop_ids]
+                        cus_a = cus_a[pop_ids]
+                    d_view = attack_lib.DefenseView(
+                        step=step_a,
+                        ema=ema_a,
+                        dev=dev_a,
+                        cusum=cus_a,
+                        rung=pol_a[0],
+                        detector=self.defense.detector,
+                        policy=self.defense.policy,
+                        guess=flat_params,
+                    )
                 w_att = self.attack.apply_message(
-                    w_stack, m_b, k_msg, param=cfg.attack_param
+                    w_stack, m_b, k_msg, param=cfg.attack_param,
+                    defense=d_view,
                 )
                 w_stack = (
                     w_att if attack_on is None
@@ -986,6 +1012,9 @@ class FedTrainer:
             attack_iter, service_state,
         ) = carry
         m_h, m_b = self._part_h, self._part_b  # participating counts
+        # iteration-start defense snapshot for the attack's DefenseView —
+        # ``defense_state`` itself is rebound mid-body (see rebuild_full)
+        defense_state_in = defense_state
         cohort = cfg.cohort_size
         n_h_chunks = m_h // cohort
         n_chunks = n_h_chunks + m_b // cohort
@@ -1111,9 +1140,43 @@ class FedTrainer:
                 # honest chunks untouched (row-local attacks only —
                 # cfg.validate rejects the omniscient ones)
                 is_byz_chunk = c_idx >= n_h_chunks
+                d_view = None
+                if self.attack.defense_aware:
+                    # chunk-local slice of the PREVIOUS iteration's
+                    # published detector rows.  MUST read the iteration-
+                    # start snapshot, not ``defense_state``: that variable
+                    # is rebound (step+1, new rung) after the observation
+                    # scan but BEFORE the aggregation pass re-invokes this
+                    # closure, and a post-update view would make the two
+                    # passes rebuild different chunks (and break resident
+                    # parity at the attack's schedule boundaries)
+                    det_s, pol_s = defense_state_in
+                    step_s, ema_s, dev_s, cus_s = det_s
+                    if cfg.service == "on":
+                        ids_v = jax.lax.dynamic_slice_in_dim(
+                            pop_ids, off, cohort
+                        )
+                        ema_v, dev_v, cus_v = (
+                            ema_s[ids_v], dev_s[ids_v], cus_s[ids_v]
+                        )
+                    else:
+                        ema_v, dev_v, cus_v = (
+                            jax.lax.dynamic_slice_in_dim(r, off, cohort)
+                            for r in (ema_s, dev_s, cus_s)
+                        )
+                    d_view = attack_lib.DefenseView(
+                        step=step_s,
+                        ema=ema_v,
+                        dev=dev_v,
+                        cusum=cus_v,
+                        rung=pol_s[0],
+                        detector=self.defense.detector,
+                        policy=self.defense.policy,
+                        guess=flat_params,
+                    )
                 w_att = self.attack.apply_message(
                     chunk, cohort, channel_lib.cohort_key(k_msg, c_idx),
-                    param=cfg.attack_param,
+                    param=cfg.attack_param, defense=d_view,
                 )
                 gate = (
                     is_byz_chunk if attack_on is None

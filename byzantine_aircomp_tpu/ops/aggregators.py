@@ -667,6 +667,58 @@ def sign_majority_vote(
 
 
 @AGGREGATORS.register(
+    "bev", extra_args=("guess", "sign_eta")
+)
+def best_effort_voting(
+    wmatrix: jnp.ndarray,
+    *,
+    guess: Optional[jnp.ndarray] = None,
+    sign_eta: Optional[float] = None,
+    **_,
+) -> jnp.ndarray:
+    """Best-effort voting (BEV-SGD, Jin et al. 2021, arXiv:2110.09660) as
+    a receiver-side rung.  BEV-SGD's insight: have every client transmit
+    its one-bit gradient sign at FULL (best-effort) power instead of
+    channel-inverted power, so a Byzantine client cannot buy extra vote
+    weight by power scaling — robustness comes from the per-coordinate
+    majority over equally-weighted ballots.  Here the vote runs on the
+    already-received full-precision stack (so it composes as an
+    escalation-ladder rung: every rung must read the same received
+    stack, unlike ``signmv`` whose one-bit BPSK transmission owns the
+    channel and is rejected by ``validate_ladder``):
+
+        new = guess + eta * sign( sum_i sign(w_i - guess) )
+
+    Each finite row casts exactly one ballot per coordinate whatever its
+    magnitude — a weightflip row a thousand honest scales out still moves
+    the vote by one ballot, so B < K/2 bounds the damage per coordinate
+    to tied-vote coordinates.  ``eta`` is ``sign_eta`` when given, else
+    the coordinatewise median of |w_i - guess| over finite rows (the
+    robust step-scale estimate ``signmv`` uses); non-finite rows cast a 0
+    ballot and count as +Inf for the eta median, and an Inf median
+    (>= K/2 non-finite deltas — outside the contract) degrades that
+    coordinate to a no-op step rather than poisoning the params."""
+    if guess is None:
+        raise ValueError("bev needs the pre-round params as `guess`")
+    k, d = wmatrix.shape
+
+    def tail(cols, g):
+        delta = cols - g[None, :]
+        finite = jnp.isfinite(delta)
+        votes = jnp.sum(jnp.where(finite, jnp.sign(delta), 0.0), axis=0)
+        if sign_eta is None:
+            eta = median(jnp.where(finite, jnp.abs(delta), jnp.inf))
+            eta = jnp.where(jnp.isfinite(eta), eta, 0.0)
+        else:
+            eta = jnp.float32(sign_eta)
+        return g + eta * jnp.sign(votes)
+
+    if k * d <= _DENSE_MAX_ELEMS:
+        return tail(wmatrix, guess)
+    return _blocked_columns((wmatrix, guess), tail)
+
+
+@AGGREGATORS.register(
     "cclip", extra_args=("guess", "clip_tau", "clip_iters")
 )
 def centered_clip(

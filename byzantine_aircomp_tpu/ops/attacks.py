@@ -27,17 +27,56 @@ Enough", Baruch et al. 2019), ``ipm`` (Inner-Product Manipulation, Xie
 et al. 2020), and the AGR-agnostic ``minmax`` / ``minsum`` (Shejwalkar &
 Houmansadr, NDSS 2021), whose in-jit bisection finds the largest
 perturbation that stays indistinguishable from honest disagreement.
+
+**Attacker knowledge tiers** (docs/DESIGN.md threat model): *data-only*
+attacks corrupt their own batches/gradients; *omniscient-stack* attacks
+read the honest rows of the transmitted stack; *defense-aware* attacks
+additionally observe the defense's published state — the robust-EMA
+baselines and CUSUM accumulators the detector carries (ByzFL,
+arXiv:2505.24802, shows static-attack evaluations systematically
+overstate robustness without this tier).  A spec with
+``defense_aware=True`` receives a :class:`DefenseView` at the message
+boundary: ``mimic`` replays the honest client the detector currently
+trusts most, ``under_radar`` bisects its perturbation magnitude in-jit so
+every Byzantine row's next CUSUM lands just under the escalation
+threshold, and ``duty_cycle`` squares its attack wave against the
+policy's ``up_n``/``down_m`` hysteresis counters (burst, sleep through
+the de-escalation window, repeat).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
 from ..registry import ATTACKS
+
+
+class DefenseView(NamedTuple):
+    """What a defense-aware attacker observes at the message boundary.
+
+    The traced leaves are the PREVIOUS iteration's published detector
+    state — the attacker reacts to what the defense has already committed
+    to, never to scores computed on the stack it is about to rewrite —
+    with the per-client rows aligned to the current stack (under service
+    subsampling the trainer gathers the drawn population ids' rows).
+    ``detector``/``policy`` are the static parameter dataclasses
+    (``defense/scores.DetectorParams`` / ``defense/policy.PolicyParams``):
+    thresholds are run configuration, realistically known to a strong
+    adversary (Kerckhoffs's principle).
+    """
+
+    step: object      # i32 scalar: detector iteration counter
+    ema: object       # [K] f32: per-client robust-EMA score baselines
+    dev: object       # [K] f32: robust deviation (scale) baselines
+    cusum: object     # [K] f32: one-sided CUSUM accumulators
+    rung: object      # i32 scalar: the ladder rung currently active
+    detector: object  # static DetectorParams
+    policy: object    # static PolicyParams
+    guess: object     # [d] f32: pre-round global params (score reference)
 
 
 @dataclass(frozen=True)
@@ -66,21 +105,61 @@ class AttackSpec:
     # gates every attack surface on a carried iteration counter, so before
     # onset the Byzantine rows are bit-identical to honest ones.
     onset_round: Optional[int] = None
+    # knowledge tiers (meta() below): an omniscient message attack reads
+    # the honest rows of the resident stack (cannot stream chunk-by-chunk);
+    # a defense-aware attack additionally receives the carried detector
+    # state as a DefenseView (requires a running defense to observe)
+    omniscient: bool = False
+    defense_aware: bool = False
+
+    def meta(self) -> dict:
+        """Static capability metadata, mirroring the aggregator registry's
+        ``AGGREGATORS.meta(name)`` pattern: consumed by ``fed/config.py``
+        validation (streaming contract, defense-aware knob contract) and
+        by ``analysis/adaptive_matrix.py`` cell gating.
+
+        * ``data_level``  — acts only inside the client local step
+          (``data_fn`` / ``grad_scale``); no message rewrite, so the
+          stack-level detector legitimately sees nothing;
+        * ``omniscient``  — the message transform reads honest-row
+          statistics off the resident stack;
+        * ``defense_aware`` — the message transform observes the
+          published detector state (``DefenseView``);
+        * ``streamable``  — safe under cohort streaming: data-level
+          always, message attacks only when row-local (not omniscient).
+        """
+        return {
+            "data_level": self.message_fn is None,
+            "omniscient": self.omniscient,
+            "defense_aware": self.defense_aware,
+            "streamable": self.message_fn is None or not self.omniscient,
+        }
 
     def apply_data(self, x, y, num_classes: int):
         if self.data_fn is None:
             return x, y
         return self.data_fn(x, y, num_classes)
 
-    def apply_message(self, wmatrix, byz_size: int, key=None, param=None):
-        # param compatibility is checked BEFORE the no-op returns so a knob
-        # set on a knob-less attack fails loudly even when the message pass
-        # would be a no-op (data-level attack, or byz_size == 0)
+    def apply_message(
+        self, wmatrix, byz_size: int, key=None, param=None, defense=None
+    ):
+        # param/defense compatibility is checked BEFORE the no-op returns
+        # so a knob set on a knob-less attack (or a defense-aware attack
+        # run without a defense view) fails loudly even when the message
+        # pass would be a no-op (data-level attack, or byz_size == 0)
         if param is not None and self.param_name is None:
             raise ValueError(f"attack {self.name!r} takes no scalar parameter")
+        if self.defense_aware and defense is None:
+            raise ValueError(
+                f"attack {self.name!r} is defense-aware: apply_message "
+                f"needs the published detector state (defense=DefenseView), "
+                f"which only exists under --defense monitor|adaptive"
+            )
         if self.message_fn is None or byz_size == 0:
             return wmatrix
         kw = {self.param_name: param} if param is not None else {}
+        if self.defense_aware:
+            kw["defense"] = defense
         return self.message_fn(wmatrix, byz_size, key, **kw)
 
 
@@ -217,44 +296,165 @@ def _minsum_message(wmatrix, byz_size, key, gamma: float = None):
     return _agr_message(wmatrix, byz_size, gamma, pred)
 
 
+def _mimic_message(wmatrix, byz_size, key, defense=None):
+    # Defense-aware replay (the "mimic" family, Karimireddy et al. 2021,
+    # steered by the published detector state): every Byzantine row
+    # replays the honest client the defense currently trusts MOST —
+    # smallest published CUSUM, EMA baseline as tie-break.  The replayed
+    # row is a genuine honest update, so no stack-level statistic can
+    # separate it from its source; the damage is over-representation (the
+    # aggregate is dragged toward one client's update, erasing the
+    # variance-reduction of averaging and amplifying that client's
+    # sampling noise byz_size-fold).
+    honest = wmatrix[:-byz_size]
+    h = honest.shape[0]
+    trust = defense.cusum[:h] + 1e-3 * defense.ema[:h]
+    tgt = jnp.argmin(trust)
+    byz = jnp.broadcast_to(honest[tgt], wmatrix[-byz_size:].shape)
+    return jnp.concatenate([honest, byz], axis=0)
+
+
+def _under_radar_message(wmatrix, byz_size, key, defense=None,
+                         margin: float = 0.9):
+    # Steered ALIE/IPM hybrid: Byzantine rows sit at mu + gamma*u, where u
+    # blends IPM's anti-mean push with ALIE's per-coordinate honest-sigma
+    # disguise, and gamma is the LARGEST magnitude whose PREDICTED
+    # detector reaction stays under the escalation threshold — the
+    # attacker replays the defense's own scoring + CUSUM arithmetic
+    # (defense/scores.py, published state in ``defense``) on the candidate
+    # stack and bisects, exactly the _agr_malicious_row machinery with the
+    # indistinguishability predicate swapped for "every Byzantine row's
+    # next z-score and CUSUM land below ``margin`` times its threshold".
+    # Before the detector's warmup arms (no flags possible) the predicate
+    # is vacuous and the attack strikes at the top of the bracket.
+    from ..defense import scores as scores_lib  # lazy: avoids a cycle at import
+
+    honest = wmatrix[:-byz_size]
+    byz_shape = wmatrix[-byz_size:].shape
+    mu = jnp.mean(honest, axis=0)
+    sig = jnp.std(honest, axis=0)
+    mu_n = jnp.maximum(jnp.linalg.norm(mu), 1e-12)
+    sig_n = jnp.maximum(jnp.linalg.norm(sig), 1e-12)
+    u = -(mu / mu_n + sig / sig_n)
+    u = u / jnp.maximum(jnp.linalg.norm(u), 1e-12)
+    dp = defense.detector
+    warm = defense.step >= dp.warmup
+
+    def stack_at(gamma):
+        return jnp.concatenate(
+            [honest, jnp.broadcast_to(mu + gamma * u, byz_shape)], axis=0
+        )
+
+    def ok(gamma):
+        # one detector step predicted from the published state (mirrors
+        # detector_update's z/CUSUM lines; tests/test_attacks.py holds a
+        # NumPy bisection oracle to this arithmetic)
+        score, _ = scores_lib.client_scores(stack_at(gamma), defense.guess)
+        z = (score - defense.ema) / (defense.dev + dp.eps)
+        cus = jnp.minimum(
+            jnp.maximum(
+                defense.cusum + jnp.clip(z, -dp.clip, dp.clip) - dp.drift,
+                0.0,
+            ),
+            2.0 * dp.cusum_thresh,
+        )
+        under = (
+            (z[-byz_size:] <= margin * dp.z_thresh)
+            & (cus[-byz_size:] <= margin * dp.cusum_thresh)
+        )
+        return jnp.all(under) | ~warm
+
+    # bracket: twice the honest mean/sigma scale plus the honest spread —
+    # beyond IPM at eps=1 and ALIE at any plausible z; gamma = 0 (the rows
+    # sit AT the honest mean) scores ~0 against a sane baseline, so the
+    # bracket low end is feasible and the bisection always converges
+    hi = 2.0 * (mu_n + sig_n) + jnp.sqrt(jnp.max(_pairwise_sq_dists(honest)))
+
+    def bisect(carry, _):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        good = ok(mid)
+        return (jnp.where(good, mid, lo), jnp.where(good, hi, mid)), None
+
+    (gamma, _), _ = jax.lax.scan(
+        bisect, (jnp.float32(0.0), hi), None, length=25
+    )
+    return stack_at(gamma)
+
+
+def _duty_cycle_message(wmatrix, byz_size, key, defense=None):
+    # Hysteresis probe: attack hard (signflip payload) for ``on_p``
+    # iterations — long enough to be flagged and climb the whole ladder —
+    # then sleep long enough for the policy's down-counter to fully
+    # de-escalate under the SEED hysteresis (down_m clean iterations PER
+    # rung), then repeat.  The schedule is squared against the published
+    # policy constants via the detector's step counter, so the burst
+    # always lands exactly when the seed ladder has dropped its guard;
+    # the policy's leaky escalation budget (defense/policy.py floor) is
+    # the shipped counter-measure.  Row-local payload: streams chunk-by-
+    # chunk (the schedule reads only the scalar step + static params).
+    pp = defense.policy
+    on_p = pp.up_n * pp.n_rungs + 2
+    period = on_p + pp.down_m * pp.n_rungs + 2
+    active = jnp.mod(defense.step, period) < on_p
+    byz = jnp.where(active, -wmatrix[-byz_size:], wmatrix[-byz_size:])
+    return jnp.concatenate([wmatrix[:-byz_size], byz], axis=0)
+
+
+def duty_cycle_schedule(policy) -> tuple:
+    """The (on_p, period) schedule ``duty_cycle`` derives from the policy
+    constants — shared with tests and the adaptive matrix so cell
+    horizons cover at least two full bursts."""
+    on_p = policy.up_n * policy.n_rungs + 2
+    return on_p, on_p + policy.down_m * policy.n_rungs + 2
+
+
 ATTACKS.register("classflip")(AttackSpec("classflip", data_fn=_classflip_data))
 ATTACKS.register("dataflip")(AttackSpec("dataflip", data_fn=_dataflip_data))
 ATTACKS.register("weightflip")(
-    AttackSpec("weightflip", message_fn=_weightflip_message)
+    AttackSpec("weightflip", message_fn=_weightflip_message, omniscient=True)
 )
 ATTACKS.register("signflip")(AttackSpec("signflip", message_fn=_signflip_message))
 ATTACKS.register("gradascent")(AttackSpec("gradascent", grad_scale=-1.0))
 ATTACKS.register("alie")(
-    AttackSpec("alie", message_fn=_alie_message, param_name="z")
+    AttackSpec("alie", message_fn=_alie_message, param_name="z",
+               omniscient=True)
 )
 ATTACKS.register("ipm")(
-    AttackSpec("ipm", message_fn=_ipm_message, param_name="eps")
+    AttackSpec("ipm", message_fn=_ipm_message, param_name="eps",
+               omniscient=True)
 )
 ATTACKS.register("gaussian")(
     AttackSpec("gaussian", message_fn=_gaussian_message, param_name="sigma")
 )
 ATTACKS.register("minmax")(
-    AttackSpec("minmax", message_fn=_minmax_message, param_name="gamma")
+    AttackSpec("minmax", message_fn=_minmax_message, param_name="gamma",
+               omniscient=True)
 )
 ATTACKS.register("minsum")(
-    AttackSpec("minsum", message_fn=_minsum_message, param_name="gamma")
+    AttackSpec("minsum", message_fn=_minsum_message, param_name="gamma",
+               omniscient=True)
 )
-
-
-# message attacks whose Byzantine rows depend only on those rows (and the
-# key): they apply chunk-by-chunk under cohort streaming.  The omniscient
-# attacks (weightflip/alie/ipm/minmax/minsum) read honest-row statistics
-# off the resident stack and cannot stream.
-_ROW_LOCAL_MESSAGES = frozenset({"signflip", "gaussian"})
+ATTACKS.register("mimic")(
+    AttackSpec("mimic", message_fn=_mimic_message, omniscient=True,
+               defense_aware=True)
+)
+ATTACKS.register("under_radar")(
+    AttackSpec("under_radar", message_fn=_under_radar_message,
+               param_name="margin", omniscient=True, defense_aware=True)
+)
+ATTACKS.register("duty_cycle")(
+    AttackSpec("duty_cycle", message_fn=_duty_cycle_message,
+               defense_aware=True)
+)
 
 
 def streamable(spec: AttackSpec) -> bool:
     """Whether the attack can run on per-cohort chunks (streamed rounds):
     data-level / grad-scale attacks act inside the client step and always
-    stream; message attacks stream only when row-local."""
-    if spec.message_fn is None:
-        return True
-    return spec.name.partition("@")[0] in _ROW_LOCAL_MESSAGES
+    stream; message attacks stream only when row-local (``meta()`` — the
+    omniscient ones read honest-row statistics off the resident stack)."""
+    return spec.meta()["streamable"]
 
 
 def resolve(name: Optional[str]) -> Optional[AttackSpec]:

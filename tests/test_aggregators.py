@@ -689,3 +689,56 @@ def test_bulyan_blocked_at_real_large_d_matches_dense_selection():
     assert not set(np.asarray(idx).tolist()) & {honest, honest + 1}
     want = np.asarray(agg.bulyan_tail(wj[idx], beta))
     np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+
+def test_bev_matches_oracle(wmat):
+    guess = wmat.mean(axis=0)
+    got = np.asarray(
+        agg.best_effort_voting(jnp.asarray(wmat), guess=jnp.asarray(guess))
+    )
+    want = numpy_ref.bev(wmat, guess=guess)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    got_e = np.asarray(
+        agg.best_effort_voting(
+            jnp.asarray(wmat), guess=jnp.asarray(guess), sign_eta=0.5
+        )
+    )
+    want_e = numpy_ref.bev(wmat, guess=guess, sign_eta=0.5)
+    np.testing.assert_allclose(got_e, want_e, rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError, match="guess"):
+        agg.best_effort_voting(jnp.asarray(wmat))
+
+
+def test_bev_equal_weight_ballots_bound_byzantine_damage(wmat):
+    # BEV-SGD's point: a row a thousand honest scales out still casts ONE
+    # ballot per coordinate — the step stays eta-bounded
+    guess = wmat.mean(axis=0)
+    w_big = wmat.copy()
+    w_big[-3:] = 1e4
+    got = np.asarray(
+        agg.best_effort_voting(jnp.asarray(w_big), guess=jnp.asarray(guess))
+    )
+    clean = np.asarray(
+        agg.best_effort_voting(jnp.asarray(wmat), guess=jnp.asarray(guess))
+    )
+    # eta is the coordinatewise median |delta| over ALL rows, of which 9
+    # of 12 are honest: the attacked step size stays honest-scale
+    assert np.abs(got - guess).max() <= np.abs(clean - guess).max() * 10
+    assert np.isfinite(got).all()
+    # non-finite rows cast a zero ballot and never poison the step
+    w_nan = wmat.copy()
+    w_nan[0] = np.nan
+    got_n = np.asarray(
+        agg.best_effort_voting(jnp.asarray(w_nan), guess=jnp.asarray(guess))
+    )
+    assert np.isfinite(got_n).all()
+
+
+def test_bev_is_a_valid_ladder_rung():
+    # bev aggregates the RECEIVED stack (no owns_channel), so
+    # validate_ladder accepts it where signmv is rejected
+    from byzantine_aircomp_tpu import defense as defense_lib
+
+    defense_lib.validate_ladder(("mean", "bev", "multi_krum"), "mean")
+    with pytest.raises(ValueError, match="owns its channel"):
+        defense_lib.validate_ladder(("mean", "signmv"), "mean")

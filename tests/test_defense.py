@@ -140,7 +140,11 @@ def _run_policy(flag_seq, p):
 
 
 def test_policy_escalates_deescalates_with_hysteresis():
-    p = defense_lib.PolicyParams(up_n=2, down_m=3, min_flagged=1, n_rungs=3)
+    # floor_thresh=0 pins the PRE-leaky-budget behavior: streak hysteresis
+    # alone, full de-escalation after a quiet spell
+    p = defense_lib.PolicyParams(
+        up_n=2, down_m=3, min_flagged=1, n_rungs=3, floor_thresh=0.0
+    )
     # two suspicious iterations per rung up; the streak resets on consume
     _, rungs = _run_policy([1, 1, 1, 1, 1, 1], p)
     assert rungs == [0, 1, 1, 2, 2, 2]  # clamped at the top rung
@@ -154,6 +158,42 @@ def test_policy_escalates_deescalates_with_hysteresis():
     # rung 0 never de-escalates below 0
     _, rungs = _run_policy([0] * 10, p)
     assert rungs == [0] * 10
+
+
+def test_policy_leaky_budget_floor_resists_duty_cycling():
+    # the duty-cycle fix: repeated escalations integrate into the budget
+    # faster than the leak drains it, and while the budget sits above
+    # floor_thresh the rung cannot de-escalate below 1 — however long the
+    # attacker sleeps between bursts
+    p = defense_lib.PolicyParams(
+        up_n=2, down_m=3, min_flagged=1, n_rungs=3,
+        floor_thresh=1.5, budget_leak=0.01,
+    )
+    burst, sleep = [1] * 6, [0] * 12
+    pol, rungs = _run_policy(burst + sleep + burst + sleep, p)
+    # first burst climbs to the top; the sleep de-escalates but the
+    # budget (~2 after two escalations) holds the floor at rung 1
+    first_sleep = rungs[6:18]
+    assert min(first_sleep) == 1 and rungs[5] == 2
+    # the second burst re-climbs from the floor, not from scratch
+    assert max(rungs[18:24]) == 2
+    assert min(rungs[24:]) == 1
+    assert float(pol[3]) > p.floor_thresh  # budget still above threshold
+    # a single transient escalation decays away without pinning the floor
+    p_single = defense_lib.PolicyParams(
+        up_n=2, down_m=3, min_flagged=1, n_rungs=3,
+        floor_thresh=1.5, budget_leak=0.01,
+    )
+    _, rungs_s = _run_policy([1, 1] + [0] * 8, p_single)
+    assert rungs_s[-1] == 0  # budget ~1 < floor_thresh: full relaxation
+
+
+def test_policy_state_is_four_tuple_with_f32_budget():
+    # the carry layout is load-bearing (fed/train.py unpacks
+    # defense_state[1][0] for the rung and donates the whole carry)
+    pol = defense_lib.init_policy()
+    assert len(pol) == 4
+    assert pol[3].dtype == jnp.float32 and float(pol[3]) == 0.0
 
 
 def test_validate_ladder_rejects_bad_ladders():
@@ -351,6 +391,26 @@ def test_adaptive_defense_retrace_single_lowering_with_onset(
     assert [e["compiled"] for e in rounds] == [True, False, False]
 
 
+@pytest.mark.parametrize("attack", ["mimic", "under_radar"])
+def test_defense_aware_attack_retrace_single_lowering(
+    attack, tmp_path, synthetic_mnist
+):
+    # CI retrace gate (-k "retrace or lowering"): threading the carried
+    # detector rows into the attacker's DefenseView (resident path) must
+    # not add a second lowering of the round fn
+    cfg = _cfg(
+        defense="adaptive", attack=attack, rounds=3,
+        obs_dir=str(tmp_path / "obs"),
+    )
+    harness.run(cfg, record_in_file=False)
+    events_file = obs_lib.events_path(
+        str(tmp_path / "obs"), harness.ckpt_title(cfg)
+    )
+    events = [json.loads(line) for line in open(events_file)]
+    (ret,) = [e for e in events if e["kind"] == "retrace"]
+    assert ret["counts"]["round_fn"] == 1 and ret["steady_state_ok"]
+
+
 # -------------------------------------------------- adaptive matrix smoke
 
 
@@ -364,11 +424,35 @@ def test_adaptive_matrix_smoke_cell():
     # while the attack ran, the escalated aggregate stayed near the honest
     # centroid (the number a successful escalation must keep small)
     assert cell["agg_err"] < 0.05
-    # data-level attacks legitimately show nothing at the stack level
+    # data-level attacks with no stack-level signature are SKIPPED
+    # explicitly, not reported as silently undetected
     quiet = adaptive_matrix.simulate_cell(
         "classflip", "monitor", iters=12, onset=3, stop=9
     )
-    assert quiet["detect_iter"] is None and quiet["max_rung"] == 0
+    assert "skipped" in quiet and "data-level" in quiet["skipped"]
+    # defense-aware attacks cannot run against --defense off (nothing
+    # published to observe): skipped, mirroring the config-level error
+    off = adaptive_matrix.simulate_cell(
+        "mimic", "off", iters=12, onset=3, stop=9
+    )
+    assert "skipped" in off and "defense-aware" in off["skipped"]
+
+
+def test_duty_cycle_matrix_cell_before_after_hysteresis_fix():
+    # the committed docs/break_matrix_*.json story, re-derived: under the
+    # seed streak-only hysteresis the ladder fully relaxes while the
+    # duty-cycled attacker sleeps; under the leaky-budget floor it stays
+    # at rung >= 1 between bursts (min_rung_post is the min rung AFTER
+    # the ladder first topped out)
+    fixed = adaptive_matrix.simulate_cell("duty_cycle", "adaptive")
+    assert fixed["max_rung"] >= 1 and fixed["min_rung_post"] >= 1
+    seed_pol = defense_lib.PolicyParams(
+        up_n=3, down_m=8, n_rungs=3, min_flagged=2, floor_thresh=0.0
+    )
+    seed = adaptive_matrix.simulate_cell(
+        "duty_cycle", "adaptive", pol=seed_pol
+    )
+    assert seed["max_rung"] >= 1 and seed["min_rung_post"] == 0
 
 
 # ----------------------------------------------- driver deadline hygiene

@@ -23,6 +23,7 @@ from byzantine_aircomp_tpu.analysis.adaptive_matrix import (
     K,
     honest_stack as _stack,
 )
+from byzantine_aircomp_tpu import defense as defense_lib
 from byzantine_aircomp_tpu.ops import aggregators as agg_lib
 from byzantine_aircomp_tpu.ops import attacks as attack_lib
 from byzantine_aircomp_tpu.registry import AGGREGATORS, ATTACKS
@@ -36,7 +37,21 @@ def test_every_aggregator_survives_every_attack(agg_name, attack_name):
     w, guess = _stack()
     spec = attack_lib.resolve(attack_name)
     key = jax.random.PRNGKey(7)
-    w_att = spec.apply_message(w, B, key)
+    d_view = None
+    if spec.meta()["defense_aware"]:
+        # defense-aware attacks read the published detector state; feed
+        # them a warm plausible view so the pairing actually executes
+        d_view = attack_lib.DefenseView(
+            step=jnp.int32(10),
+            ema=jnp.full((K,), 0.1, jnp.float32),
+            dev=jnp.full((K,), 0.05, jnp.float32),
+            cusum=jnp.zeros((K,), jnp.float32),
+            rung=jnp.int32(0),
+            detector=defense_lib.DetectorParams(),
+            policy=defense_lib.PolicyParams(),
+            guess=guess,
+        )
+    w_att = spec.apply_message(w, B, key, defense=d_view)
     assert w_att.shape == w.shape
 
     fn = agg_lib.resolve(agg_name)

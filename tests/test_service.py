@@ -259,3 +259,33 @@ def test_service_round_single_lowering(tmp_path, monkeypatch):
     parts = [e for e in events if e["kind"] == "participation"]
     assert len(parts) == 3
     assert all(e["effective_k"] >= 1 for e in parts)
+
+
+def test_service_duty_cycle_defense_aware_single_lowering(
+    tmp_path, monkeypatch
+):
+    """CI retrace-gate member: a defense-aware attack under service rounds
+    gathers the population-keyed detector rows into its DefenseView every
+    iteration — the gather must stay shape-stable (one lowering)."""
+    import byzantine_aircomp_tpu.data.datasets as dl
+    from byzantine_aircomp_tpu.fed import harness
+    from byzantine_aircomp_tpu.obs import events_path
+
+    orig = dl.load
+    monkeypatch.setattr(
+        dl, "load",
+        lambda name, **kw: orig(name, synthetic_train=600, synthetic_val=200),
+    )
+    cfg = _cfg(
+        rounds=3, honest_size=6, byz_size=3, population=27, agg="mean",
+        attack="duty_cycle", defense="adaptive",
+        defense_ladder="mean,trimmed_mean,median",
+        obs_dir=str(tmp_path / "obs"),
+    )
+    harness.run(cfg, record_in_file=False)
+    path = events_path(str(tmp_path / "obs"), harness.ckpt_title(cfg))
+    events = [json.loads(l) for l in open(path)]
+    (ret,) = [e for e in events if e["kind"] == "retrace"]
+    assert ret["counts"]["round_fn"] == 1 and ret["steady_state_ok"]
+    parts = [e for e in events if e["kind"] == "participation"]
+    assert len(parts) == 3 and all(e["effective_k"] >= 1 for e in parts)

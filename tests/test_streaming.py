@@ -243,6 +243,24 @@ def test_streamed_adaptive_defense_runs():
     assert np.isfinite(p).all()
 
 
+def test_streamed_duty_cycle_monitor_parity():
+    # duty_cycle is the one defense-aware attack that streams: its payload
+    # reads only the scalar step plus static policy constants, so the
+    # monitor-mode trajectory (detector watches, aggregator fixed) is
+    # bit-identical between resident and chunked rounds.  defense_up/down
+    # shrink the schedule so four rounds cross a burst->sleep boundary.
+    ds = _ds()
+    kw = dict(
+        byz_size=2, attack="duty_cycle", agg="median", rounds=4,
+        defense="monitor", defense_ladder="mean,trimmed_mean,median",
+        defense_up=1, defense_down=1,
+    )
+    resident = _final_params(_cfg(**kw), ds)
+    streamed = _final_params(_cfg(cohort_size=2, **kw), ds)
+    np.testing.assert_array_equal(streamed, resident)
+    assert np.isfinite(resident).all()
+
+
 # ----------------------------------------- config continuity + errors
 
 
@@ -301,6 +319,32 @@ def test_streamed_round_single_lowering(tmp_path, monkeypatch):
     # the harness swapped its peak model to the streamed formula
     (end,) = [e for e in events if e["kind"] == "run_end"]
     assert end["memory"]["hbm_model"] == "streamed"
+
+
+def test_streamed_defense_aware_attack_single_lowering(tmp_path, monkeypatch):
+    """CI retrace-gate member: threading the DefenseView into the cohort
+    scan (duty_cycle under an adaptive ladder) must not add lowerings."""
+    import byzantine_aircomp_tpu.data.datasets as dl
+    from byzantine_aircomp_tpu.fed import harness
+    from byzantine_aircomp_tpu.obs import events_path
+
+    orig = dl.load
+    monkeypatch.setattr(
+        dl, "load",
+        lambda name, **kw: orig(name, synthetic_train=600, synthetic_val=200),
+    )
+    cfg = FedConfig(
+        honest_size=6, byz_size=3, rounds=3, display_interval=2,
+        batch_size=16, agg="mean", eval_train=False, cohort_size=3,
+        attack="duty_cycle", defense="adaptive",
+        defense_ladder="mean,trimmed_mean,median",
+        obs_dir=str(tmp_path / "obs"),
+    )
+    harness.run(cfg, record_in_file=False)
+    path = events_path(str(tmp_path / "obs"), harness.ckpt_title(cfg))
+    events = [json.loads(l) for l in open(path)]
+    (ret,) = [e for e in events if e["kind"] == "retrace"]
+    assert ret["counts"]["round_fn"] == 1 and ret["steady_state_ok"]
 
 
 def test_streamed_peak_model_scales_with_cohort_not_k():

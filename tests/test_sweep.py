@@ -165,6 +165,8 @@ def test_sweep_forwards_every_shared_knob():
         "defense_up": 2,
         "defense_down": 10,
         "defense_min_flagged": 2,
+        "defense_floor": 2.5,
+        "defense_leak": 0.01,
         "cohort_size": 4,
         "cohort_quantile": "sketch",
         "cohort_sketch_bins": 256,
