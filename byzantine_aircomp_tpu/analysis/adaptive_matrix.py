@@ -122,7 +122,17 @@ def simulate_cell(
     (``agg_err``), and the final aggregate's distance to the honest mean
     of the last transmitted stack (``final_dist`` — the number the paper's
     receiver ultimately cares about).  Skipped cells return
-    ``{"skipped": reason}`` instead of fabricating a quiet row."""
+    ``{"skipped": reason}`` instead of fabricating a quiet row.
+
+    The forensic columns mirror :mod:`.audit` against the cell's known
+    ground truth (rows ``[-B:]`` are the attackers): ``precision`` =
+    flags naming an attacker row while the attack is ACTIVE / all flags
+    raised (a flag on a sleeping attacker or an honest row is a false
+    positive), ``recall`` = distinct attacker rows flagged while active
+    / ``B``, and ``time_to_detect`` = first active iteration any
+    attacker row is flagged, relative to onset.  ``detect_iter`` keeps
+    its looser seed semantics (ANY flag while active) so committed
+    matrices stay comparable."""
     spec = attack_lib.resolve(attack_name)
     meta = spec.meta()
     if mode == "off" and meta["defense_aware"]:
@@ -163,6 +173,9 @@ def simulate_cell(
     d_state = defense_lib.init_detector(K)
     p_state = defense_lib.init_policy()
     detect_iter = None
+    tp = fp = 0
+    detected_rows: set = set()
+    time_to_detect = None
     rounds_susp = 0
     max_rung = 0
     transitions = 0
@@ -205,6 +218,16 @@ def simulate_cell(
             rounds_susp += int(bool(susp))
             if detect_iter is None and active and int(jnp.sum(flags)) > 0:
                 detect_iter = t - onset
+            # forensic confusion ledger vs the cell's ground truth
+            byz_hits = [K - B + i for i in range(B) if bool(flags[K - B + i])]
+            fp += int(jnp.sum(flags[:HONEST]))
+            if active:
+                tp += len(byz_hits)
+                detected_rows.update(byz_hits)
+                if byz_hits and time_to_detect is None:
+                    time_to_detect = t - onset
+            else:
+                fp += len(byz_hits)
         if rung > max_rung:
             max_rung, max_seen_at = rung, t
         transitions += int(rung != prev_rung)
@@ -226,8 +249,14 @@ def simulate_cell(
                 jnp.linalg.norm(agg - jnp.mean(w[:HONEST], axis=0))
             )
     final_rung = int(p_state[0])
+    n_flags = tp + fp
     cell: Dict[str, object] = {
         "detect_iter": detect_iter,
+        "precision": (round(tp / n_flags, 5)
+                      if (mode != "off" and n_flags) else None),
+        "recall": (round(len(detected_rows) / B, 5)
+                   if mode != "off" else None),
+        "time_to_detect": time_to_detect,
         "rounds_suspicious": rounds_susp,
         "max_rung": max_rung,
         "min_rung_post": min_rung_post,
@@ -285,23 +314,29 @@ def markdown_table(grid: Dict[Cell, Dict[str, object]]) -> str:
     for m, lad in groups:
         head = (
             f"**mode: {m} | ladder: {lad}**\n\n| attack | detect_lat | "
-            f"susp | max_rung | min_post | final_rung | deesc | "
-            f"final_dist |"
+            f"prec | rec | ttd | susp | max_rung | min_post | "
+            f"final_rung | deesc | final_dist |"
         )
-        sep = "|---|---|---|---|---|---|---|---|"
+        sep = "|---|---|---|---|---|---|---|---|---|---|---|"
         rows = []
         for a in attacks:
             c = grid[(a, m, lad)]
             if "skipped" in c:
-                rows.append(f"| {a} | skipped | | | | | | |")
+                rows.append(f"| {a} | skipped | | | | | | | | | |")
                 continue
             lat = "-" if c["detect_iter"] is None else str(c["detect_iter"])
+            prec = ("-" if c.get("precision") is None
+                    else f"{c['precision']:.2f}")
+            rec = "-" if c.get("recall") is None else f"{c['recall']:.2f}"
+            ttd = ("-" if c.get("time_to_detect") is None
+                   else str(c["time_to_detect"]))
             post = (
                 "-" if c["min_rung_post"] is None
                 else str(c["min_rung_post"])
             )
             rows.append(
-                f"| {a} | {lat} | {c['rounds_suspicious']} | "
+                f"| {a} | {lat} | {prec} | {rec} | {ttd} | "
+                f"{c['rounds_suspicious']} | "
                 f"{c['max_rung']} | {post} | {c['final_rung']} | "
                 f"{c['deescalated']} | {c['final_dist']} |"
             )

@@ -13,7 +13,12 @@ time spent per rung, whether the run de-escalated:
 
 Works on any JSONL containing ``defense`` events; other kinds are skipped,
 and ``round`` events (matched on the round index) contribute the val-acc
-column when present.
+column when present.  ``--forensics top|full`` runs additionally append
+``client_flag`` events — when found, the per-round table gains the ids
+the detector actually accused (population ids under ``--service on``,
+client slots otherwise; the ids are whatever the round fn published, no
+translation happens here), and the summary counts distinct accused
+clients.  :mod:`.audit` scores that same stream against ground truth.
 """
 
 from __future__ import annotations
@@ -47,13 +52,21 @@ def trace(events: List[dict]) -> Dict[str, object]:
     """The escalation story from an event list.
 
     Returns ``rows`` (one dict per defense event, val_acc joined from the
-    round events), ``transitions`` (the rung-change log), and ``summary``
-    (mode, first escalation round, per-rung round counts, de-escalation)."""
+    round events, flagged client/population ids joined from any
+    ``client_flag`` events), ``transitions`` (the rung-change log), and
+    ``summary`` (mode, first escalation round, per-rung round counts,
+    de-escalation, distinct clients flagged)."""
     acc_by_round = {
         e["round"]: e.get("val_acc")
         for e in events
         if e.get("kind") == "round"
     }
+    flags_by_round: Dict[int, List[int]] = {}
+    for e in events:
+        if e.get("kind") == "client_flag" and e.get("flagged"):
+            flags_by_round.setdefault(e["round"], []).append(
+                int(e["client"])
+            )
     rows = []
     transitions = []
     rung_rounds: Dict[int, int] = {}
@@ -76,6 +89,7 @@ def trace(events: List[dict]) -> Dict[str, object]:
                 "score_max": e.get("score_max"),
                 "cusum_max": e.get("cusum_max"),
                 "val_acc": acc_by_round.get(r),
+                "flagged_clients": sorted(flags_by_round.get(r, [])) or None,
             }
         )
         if e.get("transition"):
@@ -102,6 +116,9 @@ def trace(events: List[dict]) -> Dict[str, object]:
             "rung_rounds": rung_rounds,
             "deescalated": deescalated,
             "final_rung": rows[-1]["rung"] if rows else None,
+            "clients_flagged": sorted(
+                {c for ids in flags_by_round.values() for c in ids}
+            ),
         },
     }
 
@@ -112,15 +129,20 @@ def markdown_report(result: Dict[str, object]) -> str:
     summary: Dict = result["summary"]  # type: ignore[assignment]
     out = [
         "| round | rung | agg | flagged | susp | score_max | cusum_max "
-        "| val_acc |",
-        "|---|---|---|---|---|---|---|---|",
+        "| val_acc | flagged ids |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     for r in rows:
         acc = "-" if r["val_acc"] is None else f"{r['val_acc']:.4f}"
+        ids = (
+            "-" if not r.get("flagged_clients")
+            else ",".join(str(c) for c in r["flagged_clients"])
+        )
         out.append(
             f"| {r['round']} | {r['rung']} | {r['agg']} | "
             f"{r['flagged']:.0f} | {r['suspicious_iters']:.0f} | "
-            f"{r['score_max']:.3g} | {r['cusum_max']:.3g} | {acc} |"
+            f"{r['score_max']:.3g} | {r['cusum_max']:.3g} | {acc} | "
+            f"{ids} |"
         )
     out.append("")
     if transitions:

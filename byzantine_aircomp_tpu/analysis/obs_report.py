@@ -20,18 +20,39 @@ Sections (each present only when the stream carries the events):
   against the analytic model;
 * **defense** — escalations and final rung (``defense`` events;
   ``analysis/defense_trace.py`` is the per-round deep dive);
+* **forensics** — ``client_flag`` / ``forensic_dump`` tallies from a
+  ``--forensics`` run (``analysis/audit.py`` scores the stream against
+  ground truth);
 * **faults** — dropped/erased/corrupt totals and minimum effective K;
 * **bench/perf** — any ``bench`` or ``perf`` rows in the stream.
+
+Pointing the CLI at a DIRECTORY instead of a file reports every
+``*.events.jsonl`` in it: one overview row per run (title, rounds,
+wall-clock, final acc, retrace verdict, flag count) plus the per-run
+sections beneath.  Streams whose sinks stamped the per-sink ``seq``
+counter are re-sorted by it before summarizing, so a stream assembled
+from a resumed run (append mode continues the counter) digests in true
+emission order even if tail lines landed out of order.
 """
 
 from __future__ import annotations
 
 import argparse
+import glob as glob_lib
 import json
+import os
 import sys
 from typing import Any, Dict, List, Optional
 
 from .defense_trace import load_events
+
+
+def order_events(events: List[dict]) -> List[dict]:
+    """Stable-sort by the per-sink ``seq`` stamp when every event carries
+    one (v2 sinks); otherwise file order is the only order there is."""
+    if events and all("seq" in e for e in events):
+        return sorted(events, key=lambda e: e["seq"])
+    return events
 
 
 def _fmt_bytes(n: Optional[float]) -> str:
@@ -128,6 +149,19 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
             ),
             "final_rung": defenses[-1].get("rung"),
             "final_agg": defenses[-1].get("agg"),
+        }
+
+    flags = [e for e in events if e.get("kind") == "client_flag"]
+    dumps = [e for e in events if e.get("kind") == "forensic_dump"]
+    if flags or dumps:
+        out["forensics"] = {
+            "flag_events": len(flags),
+            "flagged": sum(1 for e in flags if e.get("flagged")),
+            "clients_seen": len({e.get("client") for e in flags}),
+            "dumps": [
+                {k: e.get(k) for k in ("round", "reason", "path", "window")}
+                for e in dumps
+            ],
         }
 
     faulted = [e for e in rounds if e.get("effective_k") is not None]
@@ -231,6 +265,21 @@ def markdown_report(summary: Dict[str, Any]) -> str:
                 f" {d.get('deescalations')} de-escalation(s); final rung "
                 f"{d.get('final_rung')} (`{d.get('final_agg')}`)", ""]
 
+    fo = summary.get("forensics")
+    if fo:
+        out += ["## forensics", "",
+                f"{fo['flag_events']} client_flag event(s) "
+                f"({fo['flagged']} flagged) over {fo['clients_seen']} "
+                f"client(s) — `analysis/audit.py` scores them against "
+                f"ground truth"]
+        for d_ev in fo.get("dumps", []):
+            out.append(
+                f"- flight dump round {d_ev.get('round')} "
+                f"({d_ev.get('reason')}): `{d_ev.get('path')}` "
+                f"(window {d_ev.get('window')})"
+            )
+        out.append("")
+
     f = summary.get("faults")
     if f:
         out += ["## faults", "",
@@ -258,13 +307,63 @@ def markdown_report(summary: Dict[str, Any]) -> str:
     return "\n".join(out)
 
 
+def summarize_dir(paths: List[str]) -> Dict[str, Any]:
+    """Per-run digests for every stream in a directory, keyed by file."""
+    runs = []
+    for p in sorted(paths):
+        events = order_events(load_events(p))
+        if not events:
+            continue
+        runs.append({"path": p, "summary": summarize(events)})
+    return {"runs": runs}
+
+
+def markdown_dir_report(digest: Dict[str, Any]) -> str:
+    runs: List[dict] = digest["runs"]  # type: ignore[assignment]
+    out = [f"# obs report — {len(runs)} run(s)", "",
+           "| run | backend | rounds | secs | final acc | retrace "
+           "| flags |",
+           "|---|---|---|---|---|---|---|"]
+    for r in runs:
+        s = r["summary"]
+        run = s.get("run") or {}
+        end = s.get("run_end") or {}
+        rt = s.get("retrace")
+        fo = s.get("forensics")
+        acc = end.get("final_val_acc")
+        out.append(
+            f"| {os.path.basename(r['path'])} | {run.get('backend', '-')} |"
+            f" {end.get('rounds_run', '-')} | {end.get('elapsed_secs', '-')}"
+            f" | {'-' if acc is None else f'{acc:.4f}'} | "
+            f"{'-' if rt is None else ('OK' if rt.get('steady_state_ok') else 'FAILED')}"
+            f" | {'-' if fo is None else fo.get('flagged')} |"
+        )
+    out.append("")
+    for r in runs:
+        out += [f"---", "", f"## {os.path.basename(r['path'])}", "",
+                markdown_report(r["summary"])]
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("events", help="events JSONL path (from --obs-dir)")
+    ap.add_argument("events",
+                    help="events JSONL path, or a directory of them "
+                         "(an --obs-dir) for a multi-run report")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable summary instead")
     args = ap.parse_args(argv)
-    events = load_events(args.events)
+    if os.path.isdir(args.events):
+        paths = glob_lib.glob(os.path.join(args.events, "*.events.jsonl"))
+        digest = summarize_dir(paths)
+        if not digest["runs"]:
+            print(f"[obs_report] no *.events.jsonl with events under "
+                  f"{args.events}", file=sys.stderr)
+            return 1
+        print(json.dumps(digest, indent=2) if args.json
+              else markdown_dir_report(digest))
+        return 0
+    events = order_events(load_events(args.events))
     if not events:
         print("[obs_report] no events found", file=sys.stderr)
         return 1

@@ -239,6 +239,9 @@ ARG_TO_FIELD = {
     "obs_stdout": ("obs_stdout", None),
     "log_file": ("log_file", None),
     "quiet": ("quiet", None),
+    "forensics": ("forensics", None),
+    "forensics_top": ("forensics_top", None),
+    "flight_window": ("flight_window", None),
     "model_parallel": ("model_parallel", None),
     "rounds": ("rounds", None),
     "interval": ("display_interval", None),
@@ -417,6 +420,28 @@ def build_parser() -> argparse.ArgumentParser:
         "--quiet",
         action="store_true",
         help="suppress harness log lines on stdout (file tee still written)",
+    )
+    # client-level forensics (obs/forensics.py) — output-only like the obs
+    # knobs: excluded from the title/config hash, record bit-identical off
+    p.add_argument(
+        "--forensics",
+        choices=["off", "top", "full"],
+        default="off",
+        help="per-client flag provenance: 'top' emits client_flag events "
+        "for flagged clients in the round's top-M, 'full' emits the whole "
+        "top-M and arms the flight recorder (requires --defense)",
+    )
+    p.add_argument(
+        "--forensics-top",
+        type=int,
+        default=8,
+        help="M: suspicious clients extracted per round (<= K)",
+    )
+    p.add_argument(
+        "--flight-window",
+        type=int,
+        default=8,
+        help="W: rounds of detector carry kept in the flight-recorder ring",
     )
     p.add_argument(
         "--preset",

@@ -36,6 +36,7 @@ from .policy import (  # noqa: F401
 )
 from .scores import (  # noqa: F401
     DetectorParams,
+    client_score_components,
     client_scores,
     detector_update,
     init_detector,

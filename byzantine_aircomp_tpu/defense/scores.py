@@ -77,8 +77,15 @@ def masked_median(x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.take(srt, idx)
 
 
-def client_scores(w_stack: jnp.ndarray, guess: jnp.ndarray):
-    """Composite per-client anomaly score [K] plus the finite-row mask [K].
+def client_score_components(w_stack: jnp.ndarray, guess: jnp.ndarray):
+    """Per-client anomaly score with its three components kept separate.
+
+    Returns ``(score [K], finite [K], components [K, 3])`` where the
+    component columns are (norm_term, cos_term, dist_term) in the order of
+    the docstring below.  :func:`client_scores` is this function minus the
+    components — same expressions, so the two are bit-identical and the
+    unused components are dead code when the caller drops them (forensics
+    off traces the same program).
 
     Each term is a nonnegative RELATIVE excess (honest rows score ~0):
 
@@ -121,6 +128,18 @@ def client_scores(w_stack: jnp.ndarray, guess: jnp.ndarray):
     )
 
     score = jnp.where(finite, norm_term + cos_term + dist_term, 0.0)
+    components = jnp.where(
+        finite[:, None],
+        jnp.stack([norm_term, cos_term, dist_term], axis=1),
+        0.0,
+    )
+    return score, finite, components
+
+
+def client_scores(w_stack: jnp.ndarray, guess: jnp.ndarray):
+    """Composite per-client anomaly score [K] plus the finite-row mask [K]
+    (see :func:`client_score_components` for the score's definition)."""
+    score, finite, _ = client_score_components(w_stack, guess)
     return score, finite
 
 

@@ -350,6 +350,20 @@ class FedConfig:
     # the analytic model (obs/hbm.modeled_peak_bytes) by this factor;
     # output-only like the other obs knobs
     hbm_warn_factor: float = 2.0
+    # client-level forensics (obs/forensics.py) — output-only like the
+    # other obs knobs (excluded from config_hash, never in run_title,
+    # record/RNG bit-identical when off).  "off": no forensic code is
+    # traced; "top": in-jit top-M extraction + client_flag events for the
+    # rows the detector flagged; "full": client_flag events for the whole
+    # top-M every round + the host-side flight recorder (ring buffer of
+    # the last flight_window rounds of detector carry, dumped on every
+    # rollback trip and at run end).  Requires --defense != off (the
+    # detector produces the scores being attributed).
+    forensics: str = "off"
+    # top-M suspicious clients extracted per round (<= node_size)
+    forensics_top: int = 8
+    # flight-recorder window W: rounds of detector carry kept in the ring
+    flight_window: int = 8
 
     @property
     def node_size(self) -> int:
@@ -382,6 +396,12 @@ class FedConfig:
         "rollback", "rollback_loss_factor", "rollback_cusum",
         "rollback_widen", "rollback_max",
     )
+
+    # forensics knobs that require --forensics top|full (fault-knob
+    # contract); the forensics trio is output-only, so harness.config_hash
+    # skips all three UNCONDITIONALLY (alongside obs_dir/log_file/...)
+    # rather than via this tuple
+    _FORENSICS_KNOBS = ("forensics_top", "flight_window")
 
     def defense_ladder_names(self) -> tuple:
         """The escalation ladder as a tuple of aggregator names."""
@@ -599,6 +619,41 @@ class FedConfig:
                 self.defense_ladder_names(),
                 self.agg if self.defense == "adaptive" else None,
             )
+        if self.forensics not in ("off", "top", "full"):
+            raise ValueError(
+                f"forensics must be off|top|full, got {self.forensics!r}"
+            )
+        if self.forensics == "off":
+            # fault-knob contract: tuning a forensics knob without enabling
+            # the forensics layer would silently do nothing
+            defaults = {f.name: f.default for f in dataclasses.fields(self)}
+            touched = sorted(
+                k for k in self._FORENSICS_KNOBS
+                if getattr(self, k) != defaults[k]
+            )
+            if touched:
+                raise ValueError(
+                    f"forensics knobs {touched} require --forensics "
+                    f"top|full (they size the top-M extraction / flight "
+                    f"recorder and would otherwise silently do nothing)"
+                )
+        else:
+            if self.defense == "off":
+                raise ValueError(
+                    "--forensics attributes the defense detector's "
+                    "per-client scores — it requires --defense "
+                    "monitor|adaptive"
+                )
+            if not 1 <= self.forensics_top <= self.node_size:
+                raise ValueError(
+                    f"forensics_top must be in [1, node_size="
+                    f"{self.node_size}] (the top-k runs over the K drawn "
+                    f"rows), got {self.forensics_top}"
+                )
+            if self.flight_window < 1:
+                raise ValueError(
+                    f"flight_window must be >= 1, got {self.flight_window}"
+                )
         if self.attack is not None:
             # knowledge-tier contract (AttackSpec.meta()): a defense-aware
             # attack observes the carried detector state, which only

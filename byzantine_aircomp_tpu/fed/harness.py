@@ -205,6 +205,10 @@ def config_hash(cfg: FedConfig) -> str:
         # between an observed and an unobserved run of the same config
         "obs_dir", "obs_stdout", "log_file", "quiet",
         "profile_rounds", "hbm_warn_factor",
+        # forensics is output-only telemetry (obs/forensics.py): the knobs
+        # add events/artifacts without touching the trajectory, so like
+        # the obs knobs they are skipped UNCONDITIONALLY
+        "forensics", "forensics_top", "flight_window",
     )
     if cfg.defense == "off":
         # a defense-off config must hash identically to builds that
@@ -447,6 +451,14 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
             straggler_prob=cfg.straggler_prob,
             rollback=cfg.rollback,
         )
+    if cfg.forensics != "off":
+        # output-only, but the audit pipeline (analysis/audit.py) reads
+        # these to interpret the client_flag stream it finds alongside
+        service_fields = dict(
+            service_fields,
+            forensics=cfg.forensics,
+            forensics_top=cfg.forensics_top,
+        )
     obs.emit(
         "run_start",
         title=run_title(cfg),
@@ -513,6 +525,11 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
     if retrace is not None:
         steady_ok = retrace.check("round_fn", max_lowerings=1, warn_fn=log)
         obs.emit("retrace", counts=retrace.snapshot(), steady_state_ok=steady_ok)
+    # forensics full: the run-end flight dump (the window's final state is
+    # the on-demand complement of the per-rollback dumps the trainer wrote)
+    flight = getattr(trainer, "flight_recorder", None)
+    if flight is not None:
+        flight.dump(max(cfg.rounds - 1, 0), "run_end", obs=obs)
     # memory summary: measured watermark vs the analytic peak model.  Only
     # device-sourced watermarks are cross-checked — a host RSS includes the
     # interpreter/compiler and would trip the model on every CPU run.

@@ -42,6 +42,7 @@ import optax
 
 from .. import defense as defense_lib
 from .. import obs as obs_lib
+from ..obs import forensics as forensics_lib
 from ..data import datasets as data_lib
 from ..ops import aggregators as agg_lib
 from ..ops import attacks as attack_lib
@@ -309,6 +310,26 @@ class FedTrainer:
         # per-round [rung, flagged, suspicious, score, cusum, transitions]
         # from the last executed round (() when the defense is off)
         self.last_defense_metrics = ()
+        # client-level forensics (obs/forensics.py; cfg.forensics doc):
+        # output-only — the top-M matrix rides the per-iteration scan
+        # OUTPUTS (not the carry), adds no RNG and no checkpointed state,
+        # so off/on trajectories are bit-identical.  validate() pins
+        # forensics != off to defense != off.
+        self._forensics_on = cfg.forensics != "off"
+        # round-level [forensics_top, NUM_COLS] top-M matrix from the last
+        # executed round (() when forensics is off)
+        self.last_forensic_metrics = ()
+        # host-side flight recorder (full mode only): ring buffer of the
+        # last flight_window rounds of detector carry, dumped on each
+        # rollback trip (train()) and at run end (harness)
+        self.flight_recorder = (
+            forensics_lib.FlightRecorder(
+                cfg.flight_window,
+                cfg.obs_dir or cfg.checkpoint_dir or ".",
+            )
+            if cfg.forensics == "full"
+            else None
+        )
         # attack-onset iteration counter: i32 in the carry with "@R" syntax,
         # () otherwise so the default program's carry stays cost-free
         self.attack_iter = (
@@ -775,6 +796,7 @@ class FedTrainer:
                 )
 
         defense_metrics = ()
+        forensic = ()
         rung = None
         if self.defense is not None:
             with jax.named_scope("defense_score"):
@@ -786,8 +808,11 @@ class FedTrainer:
                 # The detector freezes state on non-finite rows, so deep-
                 # fade erasures neither trip flags nor corrupt baselines.
                 det, pol = defense_state
-                score, finite = defense_lib.client_scores(
-                    w_stack, flat_params
+                # component-returning variant: identical score/finite
+                # expressions, and with forensics off the unused component
+                # columns are dead code (the traced program is unchanged)
+                score, finite, score_parts = (
+                    defense_lib.client_score_components(w_stack, flat_params)
                 )
                 if cfg.service == "on":
                     # population-keyed detector: gather the drawn ids'
@@ -797,11 +822,13 @@ class FedTrainer:
                     # the draw keep their baselines verbatim, so scores
                     # survive non-participation.
                     step, ema, dev, cus = det
-                    first = dev[pop_ids] == 0.0
+                    ema_g, dev_g, cus_g = (
+                        ema[pop_ids], dev[pop_ids], cus[pop_ids]
+                    )
+                    first = dev_g == 0.0
                     (_, ema_r, dev_r, cus_r), flags = (
                         defense_lib.detector_update(
-                            (step, ema[pop_ids], dev[pop_ids],
-                             cus[pop_ids]),
+                            (step, ema_g, dev_g, cus_g),
                             score, finite, self.defense.detector,
                             first=first,
                         )
@@ -812,10 +839,19 @@ class FedTrainer:
                         dev.at[pop_ids].set(dev_r),
                         cus.at[pop_ids].set(cus_r),
                     )
+                    # forensic identities/baselines for the drawn rows:
+                    # stable population ids, pre-update ema/dev (the z the
+                    # detector thresholded), post-update CUSUM
+                    f_ids, ema_pre, dev_pre, cus_post = (
+                        pop_ids, ema_g, dev_g, cus_r
+                    )
                 else:
+                    f_ids = jnp.arange(cfg.node_size)
+                    ema_pre, dev_pre = det[1], det[2]
                     det, flags = defense_lib.detector_update(
                         det, score, finite, self.defense.detector
                     )
+                    cus_post = det[3]
                 n_flagged = jnp.sum(flags)
                 pol, suspicious = defense_lib.policy_update(
                     pol, n_flagged, self.defense.policy
@@ -831,6 +867,21 @@ class FedTrainer:
                     jnp.max(score),
                     jnp.max(det[3]),
                 ])
+            if self._forensics_on:
+                with jax.named_scope("forensics_top_m"):
+                    # fixed-shape top-M flag provenance ([M, NUM_COLS]);
+                    # rides the scan OUTPUTS, not the carry
+                    forensic = forensics_lib.with_rung(
+                        forensics_lib.top_m(
+                            forensics_lib.candidate_rows(
+                                f_ids, score, score_parts, ema_pre,
+                                dev_pre, cus_post, flags,
+                                self.defense.detector,
+                            ),
+                            cfg.forensics_top,
+                        ),
+                        rung,
+                    )
 
         agg_honest = m_h
         w_for_agg = w_stack
@@ -970,7 +1021,8 @@ class FedTrainer:
         else:
             service_metrics = ()
         return carry_out, (
-            variance, fault_metrics, defense_metrics, service_metrics
+            variance, fault_metrics, defense_metrics, service_metrics,
+            forensic,
         )
 
     def _iteration_streamed(self, carry, key, x_train, y_train, want_variance):
@@ -1250,12 +1302,16 @@ class FedTrainer:
             (det[1], det[2], det[3]) if self.defense is not None else (),
             jnp.int32(0) if self.defense is not None else (),
             jnp.float32(0.0) if self.defense is not None else (),
+            # running top-M forensic candidates ([M, NUM_COLS], score
+            # column seeded -inf so real rows displace the sentinels)
+            forensics_lib.stream_init(cfg.forensics_top)
+            if self._forensics_on else (),
         )
 
         def obs_body(carry_o, c_idx):
             (
                 s_all, s_fin, n_fin, s_h, ssq_h, n_h_fin, ge_acc, n_er,
-                n_co, det_rows, n_flag, max_sc,
+                n_co, det_rows, n_flag, max_sc, topm,
             ) = carry_o
             chunk, ge_c, er, co = rebuild_full(c_idx)
             fin = agg_lib._finite_rows(chunk)
@@ -1285,8 +1341,11 @@ class FedTrainer:
                 # the shared scalar step (incremented ONCE after the scan)
                 ema, dev, cus = det_rows
                 off = c_idx * cohort
-                score, score_fin = defense_lib.client_scores(
-                    chunk, flat_params
+                # component-returning variant (defense/scores.py): same
+                # score/finite values; the component columns are dead code
+                # when forensics is off
+                score, score_fin, score_parts = (
+                    defense_lib.client_score_components(chunk, flat_params)
                 )
                 if cfg.service == "on":
                     # population-keyed rows: gather this chunk's drawn ids,
@@ -1333,15 +1392,32 @@ class FedTrainer:
                     )
                 n_flag = n_flag + jnp.sum(flags)
                 max_sc = jnp.maximum(max_sc, jnp.max(score))
+                if self._forensics_on:
+                    # per-cohort top-M merge: this chunk's candidates
+                    # (stable ids under service, participant rows
+                    # otherwise; pre-update ema/dev, post-update CUSUM)
+                    # against the carried top-M — fixed [M, NUM_COLS]
+                    ids_f = (
+                        rows_c if cfg.service == "on"
+                        else off + jnp.arange(cohort, dtype=jnp.int32)
+                    )
+                    topm = forensics_lib.merge_top_m(
+                        topm,
+                        forensics_lib.candidate_rows(
+                            ids_f, score, score_parts, det_c[1], det_c[2],
+                            cus_c, flags, self.defense.detector,
+                        ),
+                        cfg.forensics_top,
+                    )
             return (
                 s_all, s_fin, n_fin, s_h, ssq_h, n_h_fin, ge_acc, n_er,
-                n_co, det_rows, n_flag, max_sc,
+                n_co, det_rows, n_flag, max_sc, topm,
             ), None
 
         with jax.named_scope("stream_observe"):
             (
                 s_all, s_fin, n_fin, s_h, ssq_h, n_h_fin, ge_new, n_er,
-                n_co, det_rows, n_flag, max_sc,
+                n_co, det_rows, n_flag, max_sc, topm,
             ), _ = jax.lax.scan(
                 obs_body, obs_init, jnp.arange(n_chunks, dtype=jnp.int32)
             )
@@ -1349,6 +1425,7 @@ class FedTrainer:
             fault_state = (stale, ge_new if needs_ge else ge_bad)
 
         defense_metrics = ()
+        forensic = ()
         rung = None
         if self.defense is not None:
             det = (det[0] + 1, det_rows[0], det_rows[1], det_rows[2])
@@ -1364,6 +1441,9 @@ class FedTrainer:
                 max_sc,
                 jnp.max(det[3]),
             ])
+            if self._forensics_on:
+                # rung at flag time, stamped once the policy has updated
+                forensic = forensics_lib.with_rung(topm, rung)
 
         with jax.named_scope("stream_aggregate"):
             kw = dict(
@@ -1442,7 +1522,8 @@ class FedTrainer:
         else:
             service_metrics = ()
         return carry_out, (
-            variance, fault_metrics, defense_metrics, service_metrics
+            variance, fault_metrics, defense_metrics, service_metrics,
+            forensic,
         )
 
     def _round_core(
@@ -1462,7 +1543,9 @@ class FedTrainer:
         effective_k] participation vector (availability at round end,
         deadline-event counts summed, effective K at its minimum) — each is
         ``()`` when its feature is off, keeping that program's output
-        structure free."""
+        structure free.  A trailing ``forensic_metrics`` element carries
+        the round's [forensics_top, NUM_COLS] top-M flag-provenance matrix
+        (obs/forensics.py), ``()`` when forensics is off."""
         interval = self.cfg.display_interval
         keys = jax.random.split(round_key, interval)
         want = jnp.arange(interval) == interval - 1
@@ -1474,7 +1557,7 @@ class FedTrainer:
         (
             final, opt_final, m_final, f_final, d_final, a_final, s_final,
         ), (
-            variances, fms, dms, sms
+            variances, fms, dms, sms, fos
         ) = jax.lax.scan(
             it,
             (flat_params, opt_state, client_m, fault_state, defense_state,
@@ -1514,9 +1597,19 @@ class FedTrainer:
             ])
         else:
             service_metrics = ()
+        if self._forensics_on:
+            # [interval, M, NUM_COLS] -> the round-level [M, NUM_COLS]
+            # top-M (a client's peak iteration wins; host-side emission
+            # dedupes repeats)
+            forensic = forensics_lib.merge_interval(
+                fos, self.cfg.forensics_top
+            )
+        else:
+            forensic = ()
         return (
             final, opt_final, m_final, f_final, d_final, a_final, s_final,
             variances[-1], fault_metrics, defense_metrics, service_metrics,
+            forensic,
         )
 
     def _build_round_fn(self):
@@ -1540,19 +1633,19 @@ class FedTrainer:
         ):
             def body(carry, r):
                 fp, os, cm, fs, ds, ai, ss = carry
-                fp, os, cm, fs, ds, ai, ss, var, fm, dm, sm = (
+                fp, os, cm, fs, ds, ai, ss, var, fm, dm, sm, fo = (
                     self._round_core(
                         fp, os, cm, fs, ds, ai, ss,
                         jax.random.fold_in(base_key, r), x_train, y_train,
                     )
                 )
-                return (fp, os, cm, fs, ds, ai, ss), (var, fm, dm, sm)
+                return (fp, os, cm, fs, ds, ai, ss), (var, fm, dm, sm, fo)
 
             (
                 final, opt_final, m_final, f_final, d_final, a_final,
                 s_final,
             ), (
-                variances, fms, dms, sms
+                variances, fms, dms, sms, fos
             ) = jax.lax.scan(
                 body,
                 (flat_params, opt_state, client_m, fault_state,
@@ -1561,7 +1654,7 @@ class FedTrainer:
             )
             return (
                 final, opt_final, m_final, f_final, d_final, a_final,
-                s_final, variances, fms, dms, sms,
+                s_final, variances, fms, dms, sms, fos,
             )
 
         return multi_fn
@@ -1635,6 +1728,7 @@ class FedTrainer:
             self.fault_state, self.defense_state, self.attack_iter,
             self.service_state, variance, self.last_fault_metrics,
             self.last_defense_metrics, self.last_service_metrics,
+            self.last_forensic_metrics,
         ) = self._round_fn(
             self.flat_params, self.server_opt_state, self.client_m,
             self.fault_state, self.defense_state, self.attack_iter,
@@ -1654,7 +1748,7 @@ class FedTrainer:
         (
             self.flat_params, self.server_opt_state, self.client_m,
             self.fault_state, self.defense_state, self.attack_iter,
-            self.service_state, variances, fms, dms, sms,
+            self.service_state, variances, fms, dms, sms, fos,
         ) = self._multi_round_fn(
             self.flat_params, self.server_opt_state, self.client_m,
             self.fault_state, self.defense_state, self.attack_iter,
@@ -1670,6 +1764,9 @@ class FedTrainer:
         )
         self.last_service_metrics = (
             sms[-1] if self.cfg.service == "on" else ()
+        )
+        self.last_forensic_metrics = (
+            fos[-1] if self._forensics_on else ()
         )
         return variances
 
@@ -1814,6 +1911,25 @@ class FedTrainer:
                     and snapshot is not None
                     and self._rollbacks_done < cfg.rollback_max
                 ):
+                    if self.flight_recorder is not None:
+                        # capture the DIVERGED round's detector carry
+                        # before the restore wipes it — this is the state
+                        # the flight dump exists to preserve
+                        det_s, pol_s = self.defense_state
+                        self.flight_recorder.record(
+                            r,
+                            detector_state=det_s,
+                            policy_state=pol_s,
+                            defense_metrics=self.last_defense_metrics,
+                            forensic_rows=np.asarray(
+                                self.last_forensic_metrics
+                            ),
+                            summary={
+                                "val_loss": va_loss,
+                                "diverged": True,
+                                "reason": reason,
+                            },
+                        )
                     host_state, shardings, snap_round = snapshot
                     (
                         self.flat_params, self.server_opt_state,
@@ -1834,6 +1950,10 @@ class FedTrainer:
                         reason=reason, epoch=self._rollback_epoch,
                         widen=float(widen) * cfg.rollback_widen,
                     )
+                    if self.flight_recorder is not None:
+                        # exactly one flight dump per guard trip, adjacent
+                        # to the rollback event it explains
+                        self.flight_recorder.dump(r, reason, obs=obs)
                     log(
                         f"[rollback {self._rollbacks_done}"
                         f"/{cfg.rollback_max}] round {r + 1} diverged "
@@ -1909,6 +2029,32 @@ class FedTrainer:
                     f" rung={int(dmetrics['rung'])}({agg_name}) "
                     f"flag={dmetrics['flagged']:.0f}"
                 )
+            if self._forensics_on and (
+                obs.enabled or self.flight_recorder is not None
+            ):
+                # flag provenance: the round's top-M matrix -> client_flag
+                # events (deduped, "top" mode keeps only flagged rows) and
+                # the flight-recorder ring.  Host-side reads only, after
+                # the round's block_until_ready barrier.
+                forensic_rows = np.asarray(self.last_forensic_metrics)
+                if obs.enabled:
+                    forensics_lib.emit_round_flags(
+                        obs, r, forensic_rows, mode=cfg.forensics
+                    )
+                if self.flight_recorder is not None:
+                    det_s, pol_s = self.defense_state
+                    self.flight_recorder.record(
+                        r,
+                        detector_state=det_s,
+                        policy_state=pol_s,
+                        defense_metrics=self.last_defense_metrics,
+                        forensic_rows=forensic_rows,
+                        summary={
+                            "val_loss": va_loss,
+                            "val_acc": va_acc,
+                            "variance": float(variance),
+                        },
+                    )
             obs.round(
                 r,
                 train_loss=tr_loss,

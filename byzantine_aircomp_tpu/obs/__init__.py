@@ -10,6 +10,8 @@ lines everywhere:
 * :mod:`.span`    — phase timing (compile vs steady-state, eval, checkpoint)
 * :mod:`.retrace` — lowering counters that catch steady-state recompilation
 * :mod:`.hbm`     — static HBM-traffic models shared by benchmarks and trainer
+* :mod:`.forensics` — per-client flag provenance (in-jit top-M extraction,
+  ``client_flag`` events) + the host-side flight recorder
 * :mod:`.profile` — jax.profiler device traces + memory watermarks
 * :mod:`.ledger`  — persisted perf ledger with noise-robust regression verdicts
 
@@ -33,6 +35,7 @@ from .events import (  # noqa: F401
     make_event,
     validate_event,
 )
+from .forensics import FlightRecorder, emit_round_flags  # noqa: F401
 from .ledger import PerfLedger, config_key, robust_stats  # noqa: F401
 from .profile import (  # noqa: F401
     NULL_PROFILER,

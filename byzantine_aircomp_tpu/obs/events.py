@@ -9,8 +9,19 @@ with three reserved keys —
   breaking field change so downstream loaders can dispatch;
 * ``kind`` — the event type (``run_start``, ``round``, ``span``, ``retrace``,
   ``run_end``, ``bench``, ``sweep_cell``, ``fault_cell``, ...);
-* ``ts``   — wall-clock epoch seconds at emission (ordering / gap analysis;
-  NEVER used for metrics — durations come from span events).
+* ``ts``   — wall-clock epoch seconds at emission (gap analysis only;
+  NEVER used for metrics — durations come from span events — and NEVER
+  used for ordering: wall-clock is non-monotonic under resume/append).
+
+Sinks additionally stamp a fourth envelope key at emission time:
+
+* ``seq``  — per-sink monotonic sequence number (``obs/sinks.py``).  A
+  JSONL sink reopened in append mode continues from the existing line
+  count, so ``seq`` is the total order analysis tools sort by even when a
+  resumed run interleaves wall-clock timestamps.  It is stamped by the
+  sink (on a copy — sinks never mutate events), so events validated
+  before emission legitimately lack it; ``validate_event`` treats it as
+  optional.
 
 The per-round ``round`` event mirrors — field for field — the reference
 pickled record the harness still writes (bitwise untouched; the event
@@ -24,7 +35,11 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Optional
 
-SCHEMA_VERSION = 1
+# v2: added the sink-stamped ``seq`` envelope key and the forensics kinds
+# ``client_flag`` / ``forensic_dump`` (obs/forensics.py).  Any change to a
+# kind's required fields MUST bump this — tests/test_schema.py pins a
+# golden fingerprint per version and fails CI on silent drift.
+SCHEMA_VERSION = 2
 
 # round-event field -> reference pickled-record key it mirrors
 # (round r's event carries metrics the record stores at index r+1 for the
@@ -73,6 +88,12 @@ _REQUIRED: Dict[str, tuple] = {
     # measurement layer (obs/profile.py, obs/ledger.py)
     "profile": ("dir",),
     "perf": ("metric", "value", "platform"),
+    # client-level forensics (obs/forensics.py): one event per suspicious
+    # client per round (``client`` is the stable population id under
+    # --service on, the stack row otherwise), and the flight-recorder
+    # dump notice pointing at the flight_<round>.json artifact
+    "client_flag": ("round", "client", "score", "rung", "flagged"),
+    "forensic_dump": ("round", "path", "reason", "window"),
 }
 
 

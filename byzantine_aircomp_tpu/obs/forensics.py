@@ -1,0 +1,264 @@
+"""Client-level forensics: in-jit top-M flag provenance + flight recorder.
+
+The defense events (``defense/events.py``) say *how many* clients were
+flagged per round and the max CUSUM — never *which* client, *why*, or
+*with what margin*.  This module is the attribution layer:
+
+* **In-jit top-M extraction** — a fixed-shape ``lax.top_k`` over the
+  detector's per-client scores, gathering the score components
+  (norm/cosine/pairwise-distance), the pre-update z-score, the post-update
+  CUSUM, and the margins to both alarm thresholds into one ``[M, NUM_COLS]``
+  f32 matrix per iteration.  The client-id column holds the stable
+  population id under ``--service on`` and the stack row otherwise.  The
+  matrix rides the round scan's per-iteration outputs exactly like the
+  defense metrics (``()`` when forensics is off), so the round fn stays at
+  one lowering.  The streamed path keeps a running top-M in the cohort
+  scan carry (:func:`stream_init` / :func:`merge_top_m`), merging each
+  cohort's candidates without materializing the full population.
+* **Host-side emission** — :func:`emit_round_flags` turns the round-level
+  matrix (iterations merged by :func:`merge_interval`, so one client can
+  surface its peak iteration) into ``client_flag`` events, deduped by
+  client id keeping the max-score row.
+* **Flight recorder** — :class:`FlightRecorder`, a host-side ring buffer
+  of the last W rounds of full detector carry + round summary stats,
+  dumped to a ``flight_<round>.json`` artifact exactly once per
+  rollback/divergence-guard trip and once at run end (reason
+  ``run_end`` -> ``flight_run_end.json``).
+
+Everything here is output-only: no RNG, no carried device state, no
+record keys — ``--forensics off`` runs are bit-identical to a build
+without this module (the knobs are excluded from ``config_hash`` in
+``fed/harness.py``).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils import io as io_lib
+from .events import SCHEMA_VERSION
+
+#: column layout of the in-jit forensic matrix ([M, NUM_COLS] f32).  The
+#: client-id column is f32 (exact for ids < 2^24 — populations are far
+#: smaller); ``rung`` is stamped after the policy update via `with_rung`.
+COLUMNS = (
+    "client",
+    "score",
+    "z",
+    "cusum",
+    "margin_z",
+    "margin_cusum",
+    "norm_term",
+    "cos_term",
+    "dist_term",
+    "flagged",
+    "rung",
+)
+NUM_COLS = len(COLUMNS)
+_SCORE_COL = COLUMNS.index("score")
+_RUNG_COL = COLUMNS.index("rung")
+
+
+def candidate_rows(ids, score, components, ema_pre, dev_pre, cusum_post,
+                   flags, p):
+    """Per-client forensic candidate rows ``[rows, NUM_COLS]`` (in-jit).
+
+    ``ids`` are the stable client identities for these rows (population
+    ids under service subsampling, stack rows otherwise); ``ema_pre`` /
+    ``dev_pre`` are the detector baselines BEFORE this iteration's update
+    (the z-score the detector actually thresholded), ``cusum_post`` the
+    statistic AFTER it (the value compared against ``p.cusum_thresh``).
+    The rung column is left 0 — callers stamp it with :func:`with_rung`
+    once the policy update has run.
+    """
+    import jax.numpy as jnp
+
+    f32 = lambda x: jnp.asarray(x, jnp.float32)  # noqa: E731
+    z = (f32(score) - f32(ema_pre)) / (f32(dev_pre) + p.eps)
+    cusum = f32(cusum_post)
+    return jnp.stack(
+        [
+            f32(ids),
+            f32(score),
+            z,
+            cusum,
+            z - p.z_thresh,
+            cusum - p.cusum_thresh,
+            f32(components[:, 0]),
+            f32(components[:, 1]),
+            f32(components[:, 2]),
+            f32(flags),
+            jnp.zeros_like(z),
+        ],
+        axis=1,
+    )
+
+
+def top_m(rows, m: int):
+    """Fixed-shape top-``m`` rows by score (``lax.top_k``; in-jit)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    _, idx = lax.top_k(rows[:, _SCORE_COL], m)
+    return jnp.take(rows, idx, axis=0)
+
+
+def merge_top_m(carry, rows, m: int):
+    """Merge a carried ``[m, NUM_COLS]`` top-M with new candidate rows
+    (streamed path: one call per cohort chunk inside the obs scan)."""
+    import jax.numpy as jnp
+
+    return top_m(jnp.concatenate([carry, rows], axis=0), m)
+
+
+def stream_init(m: int):
+    """Initial streamed-scan carry: ``[m, NUM_COLS]`` with a ``-inf``
+    score column so every real row displaces a sentinel (a population has
+    at least ``m`` rows — validated in ``fed/config.py``)."""
+    import jax.numpy as jnp
+
+    init = jnp.zeros((m, NUM_COLS), jnp.float32)
+    return init.at[:, _SCORE_COL].set(-jnp.inf)
+
+
+def with_rung(mat, rung):
+    """Stamp the active rung (scalar, post policy-update) into the rung
+    column of a forensic matrix."""
+    import jax.numpy as jnp
+
+    return mat.at[:, _RUNG_COL].set(jnp.asarray(rung, jnp.float32))
+
+
+def merge_interval(mats, m: int):
+    """Reduce the scan's stacked ``[interval, m, NUM_COLS]`` iteration
+    matrices to one round-level ``[m, NUM_COLS]`` top-M.  A client flagged
+    in several iterations appears once per iteration here; host-side
+    emission dedupes keeping its peak-score row."""
+    return top_m(mats.reshape(-1, NUM_COLS), m)
+
+
+def rows_to_records(mat) -> List[Dict[str, Any]]:
+    """Host side: np ``[M, NUM_COLS]`` -> per-client dicts, deduped by
+    client id (max score wins), sorted by descending score."""
+    mat = np.asarray(mat, np.float64)
+    best: Dict[int, np.ndarray] = {}
+    for row in mat:
+        if not np.isfinite(row[_SCORE_COL]):
+            continue  # unfilled streamed sentinel
+        cid = int(row[0])
+        if cid not in best or row[_SCORE_COL] > best[cid][_SCORE_COL]:
+            best[cid] = row
+    records = []
+    for row in sorted(best.values(), key=lambda r: -r[_SCORE_COL]):
+        rec: Dict[str, Any] = {name: float(v) for name, v in zip(COLUMNS, row)}
+        rec["client"] = int(row[0])
+        rec["flagged"] = bool(row[COLUMNS.index("flagged")] > 0.5)
+        rec["rung"] = int(row[_RUNG_COL])
+        records.append(rec)
+    return records
+
+
+def emit_round_flags(obs, round_idx: int, mat, *, mode: str) -> int:
+    """Emit ``client_flag`` events for a round's forensic matrix.
+
+    ``mode == "top"`` emits only the rows the detector actually flagged;
+    ``mode == "full"`` emits the whole top-M (margins on unflagged
+    near-threshold clients are exactly what the audit wants for
+    precision analysis).  Returns the number of events emitted.
+    """
+    n = 0
+    for rec in rows_to_records(mat):
+        if mode == "top" and not rec["flagged"]:
+            continue
+        obs.emit("client_flag", round=round_idx, **rec)
+        n += 1
+    return n
+
+
+class FlightRecorder:
+    """Ring buffer of the last W rounds of detector carry + summary stats.
+
+    ``record`` is called once per completed round from the host loop
+    (forensics ``full`` only — it forces a device->host transfer of the
+    detector state); ``dump`` writes the whole window to a JSON artifact
+    and emits one ``forensic_dump`` event.  The trainer calls it exactly
+    once per rollback/divergence-guard trip (adjacent to the ``rollback``
+    event) and the harness once more at run end.
+    """
+
+    def __init__(self, window: int, out_dir: str) -> None:
+        self.window = int(window)
+        self.out_dir = out_dir
+        self._ring: collections.deque = collections.deque(maxlen=self.window)
+        self.dumps: List[str] = []
+
+    def record(
+        self,
+        round_idx: int,
+        *,
+        detector_state=None,
+        policy_state=None,
+        defense_metrics=None,
+        forensic_rows=None,
+        summary: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        def _tolist(x):
+            return None if x is None else np.asarray(x).tolist()
+
+        snap: Dict[str, Any] = {"round": int(round_idx)}
+        if detector_state is not None:
+            step, ema, dev, cusum = detector_state
+            snap["detector"] = {
+                "step": int(np.asarray(step)),
+                "ema": _tolist(ema),
+                "dev": _tolist(dev),
+                "cusum": _tolist(cusum),
+            }
+        if policy_state is not None:
+            snap["policy"] = _tolist(policy_state)
+        if defense_metrics is not None:
+            snap["defense_metrics"] = _tolist(defense_metrics)
+        if forensic_rows is not None:
+            snap["top_m"] = rows_to_records(forensic_rows)
+        if summary:
+            snap["summary"] = dict(summary)
+        self._ring.append(snap)
+
+    def dump(self, round_idx: int, reason: str, obs=None) -> Optional[str]:
+        """Write ``flight_<round>.json`` (``flight_run_end.json`` for the
+        run-end dump) and emit a ``forensic_dump`` event; returns the path
+        (None when the window is empty — nothing recorded yet)."""
+        if not self._ring:
+            return None
+        name = (
+            "flight_run_end.json" if reason == "run_end"
+            else f"flight_{int(round_idx)}.json"
+        )
+        os.makedirs(self.out_dir, exist_ok=True)
+        path = os.path.join(self.out_dir, name)
+        payload = {
+            "v": SCHEMA_VERSION,
+            "reason": reason,
+            "round": int(round_idx),
+            "window": self.window,
+            "rounds": list(self._ring),
+        }
+        io_lib.atomic_write(
+            path, lambda f: json.dump(payload, f, default=str), mode="w"
+        )
+        self.dumps.append(path)
+        if obs is not None:
+            obs.emit(
+                "forensic_dump",
+                round=int(round_idx),
+                path=path,
+                reason=reason,
+                window=self.window,
+                rounds_recorded=len(payload["rounds"]),
+            )
+        return path

@@ -20,6 +20,13 @@ the deployment matrix:
 Sinks never mutate the events they are handed and never raise into the
 training loop for a full disk mid-run — emit failures after a successful
 open surface once as a warning on stderr and the sink disables itself.
+
+Every concrete sink stamps a per-sink monotonic ``seq`` envelope key on a
+COPY of each event before writing it (``ts`` is wall-clock and therefore
+non-monotonic under resume/append — ``seq`` is the ordering key analysis
+tools sort by).  :class:`JsonlSink` in append mode continues the counter
+from the existing line count, so a resumed run's stream stays totally
+ordered end to end.
 """
 
 from __future__ import annotations
@@ -39,6 +46,14 @@ def _dumps(event: Dict[str, Any]) -> str:
 
 class EventSink:
     """Interface: ``emit`` one event dict; ``close`` flushes/releases."""
+
+    def _stamp(self, event: Dict[str, Any]) -> Dict[str, Any]:
+        """Return a COPY of ``event`` carrying this sink's next monotonic
+        ``seq`` (the original dict is never mutated — the same event may be
+        fanned out to several sinks, each with its own counter)."""
+        seq = getattr(self, "_seq", 0)
+        self._seq = seq + 1
+        return {**event, "seq": seq}
 
     def emit(self, event: Dict[str, Any]) -> None:
         raise NotImplementedError
@@ -71,7 +86,7 @@ class MemorySink(EventSink):
         self.events: List[Dict[str, Any]] = []
 
     def emit(self, event: Dict[str, Any]) -> None:
-        self.events.append(dict(event))
+        self.events.append(self._stamp(event))
 
     def by_kind(self, kind: str) -> List[Dict[str, Any]]:
         return [e for e in self.events if e.get("kind") == kind]
@@ -85,7 +100,7 @@ class StdoutSink(EventSink):
 
     def emit(self, event: Dict[str, Any]) -> None:
         stream = self._stream or sys.stdout
-        stream.write(_dumps(event) + "\n")
+        stream.write(_dumps(self._stamp(event)) + "\n")
         stream.flush()
 
 
@@ -113,11 +128,19 @@ class JsonlSink(EventSink):
             self._fh: Optional[TextIO] = None
         else:
             self._fh = io_lib.open_append(path)
+            if not self.fresh:
+                # resume/append: continue ``seq`` from the existing line
+                # count so the file stays totally ordered across restarts
+                try:
+                    with open(path, "r") as fh:
+                        self._seq = sum(1 for _ in fh)
+                except OSError:
+                    pass
 
     def emit(self, event: Dict[str, Any]) -> None:
         if self._failed:
             return
-        line = _dumps(event)
+        line = _dumps(self._stamp(event))
         try:
             if self._atomic:
                 self._rows.append(line)

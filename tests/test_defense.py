@@ -256,6 +256,7 @@ def test_config_hash_off_matches_predefense_formula():
         "checkpoint_dir", "cache_dir", "profile_dir", "inherit", "rounds",
         "obs_dir", "obs_stdout", "log_file", "quiet",
         "profile_rounds", "hbm_warn_factor",
+        "forensics", "forensics_top", "flight_window",
     )
     items = sorted(
         (f.name, repr(getattr(cfg, f.name)))

@@ -1,0 +1,322 @@
+"""Client-level forensics: flag provenance, flight recorder, audit.
+
+The acceptance bar (ISSUE 9): `--forensics full` keeps the round fn at
+one lowering on all three execution paths (these tests are CI
+retrace-gate members via ``-k "retrace or lowering"``) while the pickled
+record stays bit-identical to `--forensics off`; the streamed top-M
+matches the resident one on a single-cohort config; the flight recorder
+dumps exactly once per divergence-guard trip; and on a seeded
+`--service on` signflip run the audit pipeline reports precision >= 0.9
+with finite time-to-detect.
+"""
+
+import glob
+import json
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu import obs as obs_lib
+from byzantine_aircomp_tpu.analysis import audit as audit_lib
+from byzantine_aircomp_tpu.fed.config import FedConfig
+from byzantine_aircomp_tpu.fed.train import FedTrainer
+from byzantine_aircomp_tpu.obs import forensics as forensics_lib
+
+
+def _cfg(**kw):
+    base = dict(
+        dataset="mnist", honest_size=6, byz_size=0, rounds=2,
+        display_interval=2, batch_size=16, agg="mean", eval_train=False,
+        defense="monitor",
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+@pytest.fixture
+def synthetic_mnist(monkeypatch):
+    import byzantine_aircomp_tpu.data.datasets as dl
+
+    orig = dl.load
+    monkeypatch.setattr(
+        dl, "load",
+        lambda name, **kw: orig(name, synthetic_train=600, synthetic_val=200),
+    )
+
+
+def _read_events(obs_dir, cfg):
+    from byzantine_aircomp_tpu.fed import harness
+
+    path = obs_lib.events_path(str(obs_dir), harness.ckpt_title(cfg))
+    return [json.loads(l) for l in open(path)]
+
+
+# --------------------------------------------------- config contracts
+
+
+def test_forensics_validation_errors():
+    def invalid(match, **kw):
+        with pytest.raises(ValueError, match=match):
+            _cfg(**kw).validate()
+
+    invalid("forensics must be off", forensics="verbose")
+    # output-only knobs are inert (and rejected) while forensics is off
+    invalid("require --forensics", forensics="off", forensics_top=4)
+    invalid("require --forensics", forensics="off", flight_window=3)
+    # the provenance comes from the defense detector: no detector, no rows
+    invalid("--defense monitor|adaptive", forensics="top", defense="off")
+    invalid("forensics_top", forensics="top", forensics_top=0)
+    invalid("forensics_top", forensics="top", forensics_top=7)  # > K=6
+    invalid("flight_window", forensics="full", forensics_top=4,
+            flight_window=0)
+    _cfg(forensics="full", forensics_top=4, flight_window=2).validate()
+
+
+def test_forensics_knobs_are_output_only():
+    from byzantine_aircomp_tpu.fed import harness
+
+    off = _cfg()
+    full = _cfg(forensics="full", forensics_top=4, flight_window=2)
+    # same checkpoints, same record paths: an audited and an unaudited
+    # run of one config must share identity
+    assert harness.config_hash(off) == harness.config_hash(full)
+    assert harness.run_title(off) == harness.run_title(full)
+    for token in ("forensic", "flight"):
+        assert token not in harness.run_title(full)
+
+
+def test_forensics_full_record_bitwise_identical(tmp_path, synthetic_mnist):
+    from byzantine_aircomp_tpu.fed import harness
+
+    plain = harness.run(_cfg(rounds=3), record_in_file=False)
+    audited = harness.run(
+        _cfg(rounds=3, forensics="full", forensics_top=4,
+             obs_dir=str(tmp_path / "obs")),
+        record_in_file=False,
+    )
+    plain.pop("roundsPerSec")
+    audited.pop("roundsPerSec")
+    assert pickle.dumps(plain) == pickle.dumps(audited)
+
+
+def test_forensics_off_traces_nothing(tmp_path, synthetic_mnist):
+    from byzantine_aircomp_tpu.fed import harness
+
+    cfg = _cfg(obs_dir=str(tmp_path / "obs"))
+    harness.run(cfg, record_in_file=False)
+    events = _read_events(tmp_path / "obs", cfg)
+    assert not [e for e in events if e["kind"] == "client_flag"]
+    assert not [e for e in events if e["kind"] == "forensic_dump"]
+    assert not glob.glob(str(tmp_path / "obs" / "flight_*.json"))
+
+
+# ------------------------------------------ one lowering on every path
+
+
+def test_forensics_resident_single_lowering(tmp_path, synthetic_mnist):
+    """CI retrace-gate member: the in-jit top-M extraction (fixed-shape
+    lax.top_k over the detector scores, riding the scan outputs) must not
+    add a second lowering to the resident round fn."""
+    from byzantine_aircomp_tpu.fed import harness
+
+    cfg = _cfg(
+        rounds=3, honest_size=4, byz_size=2, attack="signflip",
+        defense="adaptive", defense_ladder="mean,trimmed_mean,median",
+        forensics="full", forensics_top=4,
+        obs_dir=str(tmp_path / "obs"),
+    )
+    harness.run(cfg, record_in_file=False)
+    events = _read_events(tmp_path / "obs", cfg)
+    (ret,) = [e for e in events if e["kind"] == "retrace"]
+    assert ret["counts"]["round_fn"] == 1 and ret["steady_state_ok"]
+    flags = [e for e in events if e["kind"] == "client_flag"]
+    assert flags, "full mode records the whole top-M every round"
+    for e in flags:
+        obs_lib.validate_event(e)
+        assert 0 <= e["client"] < 6
+        for key in ("z", "cusum", "margin_z", "margin_cusum",
+                    "norm_term", "cos_term", "dist_term"):
+            assert key in e
+    # run_start spells the forensics knobs for the audit pipeline
+    (start,) = [e for e in events if e["kind"] == "run_start"]
+    assert start["forensics"] == "full" and start["forensics_top"] == 4
+
+
+def test_forensics_streamed_single_lowering(tmp_path, synthetic_mnist):
+    """CI retrace-gate member: the per-cohort top-M merge in the
+    streamed scan carry must stay shape-stable."""
+    from byzantine_aircomp_tpu.fed import harness
+
+    cfg = _cfg(
+        rounds=3, cohort_size=3, forensics="full", forensics_top=3,
+        obs_dir=str(tmp_path / "obs"),
+    )
+    harness.run(cfg, record_in_file=False)
+    events = _read_events(tmp_path / "obs", cfg)
+    (ret,) = [e for e in events if e["kind"] == "retrace"]
+    assert ret["counts"]["round_fn"] == 1 and ret["steady_state_ok"]
+    assert [e for e in events if e["kind"] == "client_flag"]
+
+
+def test_forensics_service_single_lowering(tmp_path, synthetic_mnist):
+    """CI retrace-gate member: population-keyed forensic gathers under
+    churn + deadline masks must stay shape-stable; flagged ids are
+    population ids."""
+    from byzantine_aircomp_tpu.fed import harness
+
+    cfg = _cfg(
+        rounds=3, honest_size=6, service="on", population=18,
+        churn_arrival=0.05, churn_departure=0.02, straggler_prob=0.2,
+        forensics="full", forensics_top=4,
+        obs_dir=str(tmp_path / "obs"),
+    )
+    harness.run(cfg, record_in_file=False)
+    events = _read_events(tmp_path / "obs", cfg)
+    (ret,) = [e for e in events if e["kind"] == "retrace"]
+    assert ret["counts"]["round_fn"] == 1 and ret["steady_state_ok"]
+    flags = [e for e in events if e["kind"] == "client_flag"]
+    assert flags
+    # ids live in population space, not stack-slot space
+    assert all(0 <= e["client"] < 18 for e in flags)
+
+
+# ------------------------------------------------ streamed == resident
+
+
+def test_streamed_top_m_matches_resident(synthetic_mnist):
+    """On a single-cohort config (cohort medians == global medians) the
+    streamed per-cohort top-M merge must reproduce the resident
+    extraction row for row."""
+    import byzantine_aircomp_tpu.data.datasets as dl
+
+    ds = dl.load("mnist")
+    kw = dict(rounds=2, forensics="full", forensics_top=4)
+    res = FedTrainer(_cfg(**kw), dataset=ds)
+    res.train()
+    st = FedTrainer(_cfg(cohort_size=6, **kw), dataset=ds)
+    st.train()
+    res_m = np.asarray(res.last_forensic_metrics)
+    st_m = np.asarray(st.last_forensic_metrics)
+    assert res_m.shape == st_m.shape == (4, forensics_lib.NUM_COLS)
+    # rank order among near-tied scores may differ; compare by client id
+    res_m = res_m[np.argsort(res_m[:, 0])]
+    st_m = st_m[np.argsort(st_m[:, 0])]
+    np.testing.assert_allclose(res_m, st_m, atol=1e-5)
+
+
+# ------------------------------------------------------ flight recorder
+
+
+def test_flight_dump_exactly_once_per_rollback(tmp_path, synthetic_mnist):
+    cfg = _cfg(
+        rounds=4, agg="trimmed_mean", service="on", population=24,
+        churn_arrival=0.05, churn_departure=0.02, straggler_prob=0.2,
+        rollback_max=2, forensics="full", forensics_top=4, flight_window=3,
+        obs_dir=str(tmp_path),
+    )
+    import byzantine_aircomp_tpu.data.datasets as dl
+
+    tr = FedTrainer(cfg, dataset=dl.load("mnist"))
+    sink = obs_lib.MemorySink()
+    obs = obs_lib.Observability(sink)
+    corrupted = []
+
+    def corrupt_once(round_idx, trainer):
+        if round_idx == 2 and not corrupted:
+            corrupted.append(round_idx)
+            trainer.flat_params = trainer.flat_params * jnp.float32(np.nan)
+
+    tr.train(checkpoint_fn=corrupt_once, obs=obs)
+    assert len([e for e in sink.events if e["kind"] == "rollback"]) == 1
+    dumps = [e for e in sink.events if e["kind"] == "forensic_dump"]
+    # EXACTLY one dump per guard trip — not one per ring entry, not zero
+    assert len(dumps) == 1
+    (ev,) = dumps
+    assert ev["reason"] == "non_finite" and ev["window"] == 3
+    assert os.path.exists(ev["path"])
+    payload = json.load(open(ev["path"]))
+    assert payload["reason"] == "non_finite"
+    assert 1 <= len(payload["rounds"]) <= 3
+    # the dump preserves the DIVERGED state the restore erased
+    last = payload["rounds"][-1]
+    assert last["summary"]["diverged"] is True
+    assert tr.flight_recorder.dumps == [ev["path"]]
+
+
+def test_flight_dump_on_run_end(tmp_path, synthetic_mnist):
+    from byzantine_aircomp_tpu.fed import harness
+
+    cfg = _cfg(forensics="full", forensics_top=4, flight_window=2,
+               obs_dir=str(tmp_path / "obs"))
+    harness.run(cfg, record_in_file=False)
+    events = _read_events(tmp_path / "obs", cfg)
+    dumps = [e for e in events if e["kind"] == "forensic_dump"]
+    assert len(dumps) == 1 and dumps[0]["reason"] == "run_end"
+    path = str(tmp_path / "obs" / "flight_run_end.json")
+    assert dumps[0]["path"] == path and os.path.exists(path)
+    payload = json.load(open(path))
+    # ring depth 2 over a 2-round run: both rounds present, each with
+    # the detector carry + the top-M provenance rows
+    assert len(payload["rounds"]) == 2
+    for snap in payload["rounds"]:
+        assert "detector" in snap and "top_m" in snap
+
+
+# -------------------------------------------------------- the audit bar
+
+
+def test_audit_precision_and_time_to_detect(tmp_path, synthetic_mnist):
+    """The ISSUE 9 acceptance criterion: on a seeded --service on run
+    with signflip attackers, the audit pipeline reports precision >= 0.9
+    and a finite time-to-detect."""
+    from byzantine_aircomp_tpu.fed import harness
+
+    cfg = _cfg(
+        # churn/stragglers off: a straggling honest population's stale row
+        # scores anomalous too, which tests availability — not attribution
+        rounds=3, honest_size=12, byz_size=4, population=48,
+        service="on", attack="signflip", defense="adaptive",
+        defense_ladder="mean,trimmed_mean,median", seed=0,
+        forensics="top", forensics_top=8, obs_dir=str(tmp_path / "obs"),
+        # K=16 would auto-shard over the 8 forced host devices; layout is
+        # orthogonal to the event stream being audited
+        sharded=False,
+    )
+    harness.run(cfg, record_in_file=False)
+    path = obs_lib.events_path(str(tmp_path / "obs"), harness.ckpt_title(cfg))
+    result = audit_lib.audit(audit_lib.load_events(path))
+    s = result["summary"]
+    assert s["ground_truth"]["byz_ids"] == list(range(36, 48))
+    assert s["flag_events"] > 0
+    assert s["precision"] is not None and s["precision"] >= 0.9
+    assert s["time_to_detect"] is not None
+    assert s["recall"] > 0
+    # mode=top emits only accusations — every timeline row is a flag
+    for rows in result["timelines"].values():
+        assert all(r["flagged"] for r in rows)
+    # the per-round table is populated and precision-annotated
+    assert result["rounds"] and all(
+        r["precision"] is not None for r in result["rounds"]
+    )
+    # the markdown report renders without error
+    assert "precision" in audit_lib.markdown_report(result)
+
+
+def test_audit_resident_ground_truth(synthetic_mnist):
+    # resident geometry: the last byz_size stack rows are the attackers
+    events = [
+        obs_lib.make_event("run_start", title="t", backend="cpu",
+                           rounds=2, start_round=0, k=8, byz=2),
+        obs_lib.make_event("client_flag", round=0, client=7, score=9.0,
+                           rung=0, flagged=True),
+        obs_lib.make_event("client_flag", round=1, client=1, score=8.0,
+                           rung=0, flagged=True),
+    ]
+    s = audit_lib.audit(events)["summary"]
+    assert s["ground_truth"]["byz_ids"] == [6, 7]
+    assert s["precision"] == pytest.approx(0.5)
+    assert s["recall"] == pytest.approx(0.5)
+    assert s["time_to_detect"] == 0

@@ -77,7 +77,47 @@ def test_multi_sink_fans_out(tmp_path):
 def test_stdout_sink_json_lines(capsys):
     obs_lib.StdoutSink().emit(obs_lib.make_event("a", x=1))
     row = json.loads(capsys.readouterr().out.strip())
-    assert row["kind"] == "a" and row["x"] == 1 and row["v"] == 1
+    assert (row["kind"] == "a" and row["x"] == 1
+            and row["v"] == obs_lib.SCHEMA_VERSION)
+
+
+def test_sinks_stamp_per_sink_monotonic_seq(tmp_path):
+    mem = obs_lib.MemorySink()
+    for i in range(3):
+        mem.emit(obs_lib.make_event("a", x=i))
+    assert [e["seq"] for e in mem.events] == [0, 1, 2]
+    # MultiSink delegates: each sub-sink keeps its OWN counter (streams
+    # are per-file artifacts, so a shared counter would leave gaps)
+    p = str(tmp_path / "multi.jsonl")
+    mem2 = obs_lib.MemorySink()
+    multi = obs_lib.MultiSink([mem2, obs_lib.JsonlSink(p)])
+    multi.emit(obs_lib.make_event("a"))
+    multi.emit(obs_lib.make_event("b"))
+    multi.close()
+    assert [e["seq"] for e in mem2.events] == [0, 1]
+    assert [json.loads(l)["seq"] for l in open(p)] == [0, 1]
+
+
+def test_jsonl_seq_continues_across_append(tmp_path):
+    # resume semantics: a reopened stream continues the counter from the
+    # existing line count, so one file never repeats a seq
+    p = str(tmp_path / "ev.jsonl")
+    s1 = obs_lib.JsonlSink(p)
+    s1.emit(obs_lib.make_event("a"))
+    s1.emit(obs_lib.make_event("a"))
+    s1.close()
+    s2 = obs_lib.JsonlSink(p)
+    s2.emit(obs_lib.make_event("a"))
+    s2.close()
+    assert [json.loads(l)["seq"] for l in open(p)] == [0, 1, 2]
+
+
+def test_sinks_never_mutate_the_caller_event():
+    # seq is stamped on a COPY: the dict a caller hands to emit stays
+    # theirs (the trainer reuses event dicts across sinks)
+    e = obs_lib.make_event("a", x=1)
+    obs_lib.MemorySink().emit(e)
+    assert "seq" not in e
 
 
 # ------------------------------------------------------------ schema

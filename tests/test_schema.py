@@ -1,0 +1,98 @@
+"""Schema-drift gate: the event stream is a public artifact.
+
+The analysis tools (`audit`, `defense_trace`, `obs_report`), the CI
+report artifacts, and any dashboards a user pointed at an `--obs-dir`
+all parse `*.events.jsonl` by field name.  A silently changed required
+field breaks them at a distance — so this module pins a golden
+fingerprint of the per-kind required-field map for every published
+SCHEMA_VERSION, and statically cross-checks that every required kind is
+documented in docs/OBSERVABILITY.md.  Changing `_REQUIRED` (or
+`REFERENCE_KEY_MAP`) without bumping `SCHEMA_VERSION` — or bumping
+without adding the new golden row here — fails CI here, not in a
+consumer.
+"""
+
+import hashlib
+import os
+import re
+
+from byzantine_aircomp_tpu import obs as obs_lib
+from byzantine_aircomp_tpu.obs import events as events_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fingerprint(required: dict, key_map: dict) -> str:
+    """Canonical digest of the schema surface: the per-kind required
+    fields plus the reference-record key mapping, order-independent."""
+    canon = "|".join(
+        f"{kind}:{','.join(fields)}"
+        for kind, fields in sorted(required.items())
+    ) + "||" + "|".join(f"{k}={v}" for k, v in sorted(key_map.items()))
+    return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+# one golden row per published schema version.  To CHANGE the schema:
+# bump SCHEMA_VERSION in obs/events.py, run the test, and append the new
+# (version, fingerprint) pair here — the diff then shows reviewers
+# exactly which version introduced which fields.  Editing an EXISTING
+# row is the drift this gate exists to catch.
+GOLDEN = {
+    2: "a5033a62e61ad318",
+}
+
+
+def test_schema_version_has_a_golden_fingerprint():
+    assert events_lib.SCHEMA_VERSION in GOLDEN, (
+        f"SCHEMA_VERSION {events_lib.SCHEMA_VERSION} has no golden "
+        f"fingerprint — append it to tests/test_schema.py::GOLDEN so the "
+        f"schema change is pinned"
+    )
+
+
+def test_schema_fingerprint_matches_golden():
+    got = _fingerprint(events_lib._REQUIRED, events_lib.REFERENCE_KEY_MAP)
+    want = GOLDEN[events_lib.SCHEMA_VERSION]
+    assert got == want, (
+        f"event schema drifted under SCHEMA_VERSION "
+        f"{events_lib.SCHEMA_VERSION}: fingerprint {got} != golden {want}."
+        f" Required fields or REFERENCE_KEY_MAP changed — bump "
+        f"SCHEMA_VERSION in obs/events.py and add the new golden row"
+    )
+
+
+def test_every_required_kind_documented_in_observability_md():
+    doc = open(os.path.join(REPO, "docs", "OBSERVABILITY.md")).read()
+    # the schema table documents each kind as a `| `kind` | ...` row
+    documented = set(re.findall(r"^\|\s*`(\w+)`\s*\|", doc, re.MULTILINE))
+    missing = sorted(set(events_lib._REQUIRED) - documented)
+    assert not missing, (
+        f"kinds with required fields but no row in docs/OBSERVABILITY.md's "
+        f"schema table: {missing}"
+    )
+
+
+def test_docs_state_current_schema_version():
+    doc = open(os.path.join(REPO, "docs", "OBSERVABILITY.md")).read()
+    m = re.search(r"SCHEMA_VERSION`, currently (\d+)", doc)
+    assert m, "docs/OBSERVABILITY.md no longer states the schema version"
+    assert int(m.group(1)) == events_lib.SCHEMA_VERSION, (
+        f"docs/OBSERVABILITY.md says schema version {m.group(1)}, code "
+        f"says {events_lib.SCHEMA_VERSION}"
+    )
+
+
+def test_make_event_stamps_current_version_and_validates():
+    e = obs_lib.make_event("client_flag", round=0, client=3, score=1.0,
+                           rung=0, flagged=True)
+    assert e["v"] == events_lib.SCHEMA_VERSION
+    assert obs_lib.validate_event(e) is e
+
+
+def test_seq_is_optional_in_validation():
+    # seq is stamped by sinks at write time; events validated before
+    # emission legitimately lack it and must stay valid
+    e = obs_lib.make_event("span", name="x", ms=1.0)
+    assert "seq" not in e
+    obs_lib.validate_event(e)
+    obs_lib.validate_event({**e, "seq": 17})
