@@ -500,6 +500,23 @@ def main() -> None:
     if relay is not None:
         result["relay"] = relay
     emit_row(result)
+    if result.get("fallback_reason"):
+        # loud, last, and unmissable: BENCH_r02–r05 were CPU fallbacks
+        # that sat in the ledger unnoticed because the only provenance
+        # was a JSON field nobody read.  The row itself stays honest
+        # (platform + fallback_reason are in it) — this banner is for
+        # the human watching the run.
+        log("=" * 64)
+        log(
+            "WARNING: this bench row is a FALLBACK "
+            f"(platform={result.get('platform')!r}, not the accelerator)"
+        )
+        log(f"  reason: {result['fallback_reason']}")
+        log(
+            "  do not read it as an accelerator headline; gate headline "
+            "rows with perf_gate --expect-platform tpu"
+        )
+        log("=" * 64)
 
 
 if __name__ == "__main__":

@@ -30,21 +30,32 @@ from typing import Dict, List, Optional
 
 
 def load_events(path: str) -> List[dict]:
-    """All schema-valid JSON objects in the file, in order; malformed
-    lines are skipped with a note (a killed run may truncate its tail)."""
+    """All schema-valid JSON objects in the stream, in order; malformed
+    lines are skipped with a note (a killed run may truncate its tail).
+
+    ``path`` names the LIVE file of a stream; when ``--obs-rotate-mb``
+    rotation produced ``<path>.NNNN`` segments alongside it, they are
+    read first (oldest to newest) so the concatenation preserves the
+    sink's monotonic ``seq`` envelope — every downstream consumer
+    (obs_report / audit / this tool) sees a rotated run as one stream.
+    """
+    from ..obs.sinks import rotated_segments
+
     events = []
-    with open(path) as f:
-        for i, line in enumerate(f):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                events.append(json.loads(line))
-            except json.JSONDecodeError:
-                print(
-                    f"[defense_trace] skipping malformed line {i + 1}",
-                    file=sys.stderr,
-                )
+    for p in rotated_segments(path) + [path]:
+        with open(p) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    print(
+                        f"[defense_trace] skipping malformed line {i + 1} "
+                        f"of {p}",
+                        file=sys.stderr,
+                    )
     return events
 
 

@@ -242,6 +242,10 @@ ARG_TO_FIELD = {
     "forensics": ("forensics", None),
     "forensics_top": ("forensics_top", None),
     "flight_window": ("flight_window", None),
+    "metrics": ("metrics", None),
+    "metrics_port": ("metrics_port", None),
+    "alerts": ("alerts", None),
+    "obs_rotate_mb": ("obs_rotate_mb", None),
     "model_parallel": ("model_parallel", None),
     "rounds": ("rounds", None),
     "interval": ("display_interval", None),
@@ -415,6 +419,39 @@ def build_parser() -> argparse.ArgumentParser:
         type=str,
         default="",
         help="tee harness log lines to this file (append, flushed per line)",
+    )
+    # live telemetry (obs/metrics.py / exporter.py / alerts.py) — output-
+    # only like the other obs knobs: derived from the event stream on the
+    # host, never part of the title/config hash, record bit-identical off
+    p.add_argument(
+        "--metrics",
+        choices=["off", "on"],
+        default="off",
+        help="fold the event stream into an in-process metrics registry "
+        "(counters/gauges/histograms; implied by --metrics-port/--alerts)",
+    )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="serve Prometheus /metrics + /healthz on this port for the "
+        "duration of the run (0 = no exporter)",
+    )
+    p.add_argument(
+        "--alerts",
+        type=str,
+        default="off",
+        help="SLO alert rules evaluated each round: 'default' for the "
+        "built-in pack (rollback rate, effective-K floor, stragglers, "
+        "rounds/sec floor, HBM watermark, retrace, non-finite loss) or a "
+        "path to a JSON rule list; alert events join the stream",
+    )
+    p.add_argument(
+        "--obs-rotate-mb",
+        type=float,
+        default=0.0,
+        help="rotate the --obs-dir event stream once the live file "
+        "passes this many MiB (segments keep one seq envelope; 0 = off)",
     )
     p.add_argument(
         "--quiet",

@@ -364,6 +364,22 @@ class FedConfig:
     forensics_top: int = 8
     # flight-recorder window W: rounds of detector carry kept in the ring
     flight_window: int = 8
+    # live telemetry (obs/metrics.py, obs/exporter.py, obs/alerts.py) —
+    # output-only like every obs knob: excluded from config_hash, never
+    # in run_title, record/RNG bit-identical off vs on.  "on" folds the
+    # event stream into an in-process metrics registry (a sink in the
+    # ordinary fan-out; the jitted round fn is untouched)
+    metrics: str = "off"
+    # serve Prometheus /metrics + /healthz on this port (implies
+    # --metrics on); 0 disables the exporter
+    metrics_port: int = 0
+    # SLO alert rules evaluated each round on the registry (implies
+    # --metrics on): "off", "default" (the built-in pack), or a path to
+    # a JSON rule list — see docs/OBSERVABILITY.md
+    alerts: str = "off"
+    # rotate the --obs-dir event stream once the live file passes this
+    # many MiB (0 = one unbounded file); segments keep one seq envelope
+    obs_rotate_mb: float = 0.0
 
     @property
     def node_size(self) -> int:
@@ -557,6 +573,31 @@ class FedConfig:
         assert self.hbm_warn_factor > 0, (
             f"hbm_warn_factor must be positive, got {self.hbm_warn_factor}"
         )
+        # live-telemetry knobs (all output-only; see docs/OBSERVABILITY.md)
+        assert self.metrics in ("off", "on"), (
+            f"metrics must be off|on, got {self.metrics!r}"
+        )
+        assert 0 <= self.metrics_port <= 65535, (
+            f"metrics_port must be a port number (0 disables), got "
+            f"{self.metrics_port}"
+        )
+        assert self.alerts, (
+            "alerts must be 'off', 'default', or a JSON rules path — got "
+            "an empty string"
+        )
+        if self.alerts not in ("off", "default"):
+            # fail on a malformed rules file at startup, not at round 0
+            from ..obs.alerts import load_rules
+
+            load_rules(self.alerts)
+        assert self.obs_rotate_mb >= 0, (
+            f"obs_rotate_mb must be >= 0 (0 disables rotation), got "
+            f"{self.obs_rotate_mb}"
+        )
+        if self.obs_rotate_mb > 0:
+            # fault-knob contract: rotation without a file stream would
+            # silently do nothing
+            assert self.obs_dir, "obs_rotate_mb requires --obs-dir"
         assert self.defense in ("off", "monitor", "adaptive"), (
             f"defense must be off|monitor|adaptive, got {self.defense!r}"
         )

@@ -209,6 +209,10 @@ def config_hash(cfg: FedConfig) -> str:
         # add events/artifacts without touching the trajectory, so like
         # the obs knobs they are skipped UNCONDITIONALLY
         "forensics", "forensics_top", "flight_window",
+        # live telemetry (obs/metrics.py, obs/exporter.py, obs/alerts.py)
+        # derives everything from the event stream on the host — same
+        # output-only contract, skipped UNCONDITIONALLY
+        "metrics", "metrics_port", "alerts", "obs_rotate_mb",
     )
     if cfg.defense == "off":
         # a defense-off config must hash identically to builds that
@@ -331,6 +335,18 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
     # the full text survives only under --log-file
     restore_stderr = env_lib.condense_stderr_warnings(cfg.log_file)
     obs = obs_lib.from_config(cfg, ckpt_title(cfg))
+    if cfg.metrics_port > 0:
+        # scrape endpoint up BEFORE training so /metrics answers while
+        # the first round is still compiling; obs.close() (the finally
+        # below) shuts it down on run end and crash alike
+        obs.exporter = obs_lib.MetricsExporter(
+            obs.registry,
+            port=cfg.metrics_port,
+            health_fn=obs.metrics_sink.health,
+        ).start()
+        log(
+            f"Serving /metrics and /healthz on port {obs.exporter.port}"
+        )
     try:
         return _run_inner(cfg, record_in_file, obs)
     finally:
@@ -598,6 +614,33 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
         final_val_loss=paths["valLossPath"][-1],
         memory=memory,
     )
+    # live telemetry epilogue: one last rule evaluation (the retrace and
+    # HBM-watermark gauges only exist after the run_end fold above), the
+    # alert summary on the log, and the registry dump as an event — the
+    # artifact `obs/alerts.py --gate` and dashboards read post-hoc
+    last_round = max(cfg.rounds - 1, 0)
+    alert_summary = None
+    if obs.alert_engine is not None:
+        alert_summary = obs.alert_engine.finalize(last_round, obs.sink)
+        if alert_summary["total_fired"]:
+            fired = {
+                name: info["fired"]
+                for name, info in alert_summary["rules"].items()
+                if info["fired"]
+            }
+            log(
+                f"ALERTS: {alert_summary['total_fired']} fired "
+                f"(worst severity {alert_summary['worst']}): {fired}"
+            )
+        else:
+            log("ALERTS: none fired")
+    if obs.registry is not None:
+        obs.emit(
+            "metrics_snapshot",
+            round=last_round,
+            metrics=obs.registry.snapshot(),
+            alerts=alert_summary,
+        )
 
     record = {
         # dataset config block (reference dataSetConfig, :536-541)

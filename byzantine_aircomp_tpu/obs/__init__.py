@@ -14,6 +14,9 @@ lines everywhere:
   ``client_flag`` events) + the host-side flight recorder
 * :mod:`.profile` — jax.profiler device traces + memory watermarks
 * :mod:`.ledger`  — persisted perf ledger with noise-robust regression verdicts
+* :mod:`.metrics` — live in-process metrics registry fed by the event stream
+* :mod:`.exporter` — Prometheus-text /metrics + /healthz scrape endpoint
+* :mod:`.alerts`  — declarative SLO rules evaluated each round on the registry
 
 :class:`Observability` is the façade the harness/trainer thread through:
 ``obs.span(...)`` / ``obs.round(...)`` / ``obs.emit(...)``.  The disabled
@@ -35,8 +38,11 @@ from .events import (  # noqa: F401
     make_event,
     validate_event,
 )
+from .alerts import AlertEngine, load_rules  # noqa: F401
+from .exporter import MetricsExporter  # noqa: F401
 from .forensics import FlightRecorder, emit_round_flags  # noqa: F401
 from .ledger import PerfLedger, config_key, robust_stats  # noqa: F401
+from .metrics import MetricsRegistry, MetricsSink  # noqa: F401
 from .profile import (  # noqa: F401
     NULL_PROFILER,
     Profiler,
@@ -56,13 +62,32 @@ from .span import SpanTimer
 
 
 class Observability:
-    """Façade bundling a sink with the span timer and round collector."""
+    """Façade bundling a sink with the span timer and round collector.
 
-    def __init__(self, sink: EventSink) -> None:
+    The live-telemetry attachments are optional and host-side only:
+    ``registry``/``metrics_sink`` when ``--metrics`` is on (the sink
+    rides in the ordinary fan-out), ``alert_engine`` when ``--alerts``
+    is set (evaluated after every round event, on every execution path
+    — resident, streamed, service — because all three share this
+    façade), and ``exporter`` when the harness opened a scrape port
+    (closed here so crash and run end both release it).
+    """
+
+    def __init__(
+        self,
+        sink: EventSink,
+        registry=None,
+        metrics_sink=None,
+        alert_engine=None,
+    ) -> None:
         self.sink = sink
         self.enabled = not isinstance(sink, NullSink)
         self._spans = SpanTimer(sink)
         self.collector = Collector(sink)
+        self.registry = registry
+        self.metrics_sink = metrics_sink
+        self.alert_engine = alert_engine
+        self.exporter = None
 
     def emit(self, kind: str, **fields) -> None:
         self.sink.emit(make_event(kind, **fields))
@@ -72,8 +97,15 @@ class Observability:
 
     def round(self, round_idx: int, **metrics) -> None:
         self.collector.round_event(round_idx, **metrics)
+        if self.alert_engine is not None:
+            # rule windows sample AFTER the round event folded into the
+            # registry, so a rule at round r sees the state through r
+            self.alert_engine.evaluate(round_idx, self.sink)
 
     def close(self) -> None:
+        if self.exporter is not None:
+            self.exporter.close()
+            self.exporter = None
         self.sink.close()
 
 
@@ -89,13 +121,37 @@ def events_path(obs_dir: str, title: str) -> str:
 
 
 def from_config(cfg, title: str) -> Observability:
-    """Build the configured Observability for a run (``NULL`` when both
-    ``obs_dir`` and ``obs_stdout`` are unset)."""
+    """Build the configured Observability for a run (``NULL`` when no
+    obs knob is set).  ``--metrics-port`` and ``--alerts`` imply the
+    metrics registry; the registry implies nothing else — a
+    metrics-only run writes no file and prints no event."""
     sinks = []
     if getattr(cfg, "obs_dir", ""):
-        sinks.append(JsonlSink(events_path(cfg.obs_dir, title)))
+        sinks.append(
+            JsonlSink(
+                events_path(cfg.obs_dir, title),
+                rotate_mb=getattr(cfg, "obs_rotate_mb", 0.0),
+            )
+        )
     if getattr(cfg, "obs_stdout", False):
         sinks.append(StdoutSink())
+    metrics_on = (
+        getattr(cfg, "metrics", "off") == "on"
+        or getattr(cfg, "metrics_port", 0) > 0
+        or getattr(cfg, "alerts", "off") != "off"
+    )
+    registry = metrics_sink = alert_engine = None
+    if metrics_on:
+        registry = MetricsRegistry()
+        metrics_sink = MetricsSink(registry)
+        sinks.append(metrics_sink)
+        if getattr(cfg, "alerts", "off") != "off":
+            alert_engine = AlertEngine(load_rules(cfg.alerts), registry)
     if not sinks:
         return NULL
-    return Observability(sinks[0] if len(sinks) == 1 else MultiSink(sinks))
+    return Observability(
+        sinks[0] if len(sinks) == 1 else MultiSink(sinks),
+        registry=registry,
+        metrics_sink=metrics_sink,
+        alert_engine=alert_engine,
+    )

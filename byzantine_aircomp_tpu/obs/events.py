@@ -36,10 +36,14 @@ import time
 from typing import Any, Dict, Optional
 
 # v2: added the sink-stamped ``seq`` envelope key and the forensics kinds
-# ``client_flag`` / ``forensic_dump`` (obs/forensics.py).  Any change to a
-# kind's required fields MUST bump this — tests/test_schema.py pins a
-# golden fingerprint per version and fails CI on silent drift.
-SCHEMA_VERSION = 2
+# ``client_flag`` / ``forensic_dump`` (obs/forensics.py).
+# v3: added the live-telemetry kinds ``alert`` (obs/alerts.py SLO rule
+# transitions) and ``metrics_snapshot`` (end-of-run registry dump from
+# obs/metrics.py).  Any change to a kind's required fields MUST bump this
+# — tests/test_schema.py pins a golden fingerprint per version and fails
+# CI on silent drift (``python tests/test_schema.py --regen`` prints the
+# new golden row and the doc table stubs a bump requires).
+SCHEMA_VERSION = 3
 
 # round-event field -> reference pickled-record key it mirrors
 # (round r's event carries metrics the record stores at index r+1 for the
@@ -94,6 +98,11 @@ _REQUIRED: Dict[str, tuple] = {
     # dump notice pointing at the flight_<round>.json artifact
     "client_flag": ("round", "client", "score", "rung", "flagged"),
     "forensic_dump": ("round", "path", "reason", "window"),
+    # live telemetry (obs/metrics.py, obs/alerts.py): an SLO rule edge
+    # (``firing`` True on breach, False on clear — steady state is NOT
+    # re-emitted every round) and the end-of-run metrics-registry dump
+    "alert": ("round", "rule", "severity", "value", "firing"),
+    "metrics_snapshot": ("round", "metrics"),
 }
 
 

@@ -1,0 +1,109 @@
+"""Scrape endpoint: /metrics (Prometheus text format) + /healthz.
+
+A zero-dependency stdlib HTTP server on a daemon thread, so an always-on
+service run (``--service on``) can be watched by any Prometheus-
+compatible scraper — or plain curl — without adding a client library to
+the image.  The server only READS the :class:`~.metrics.MetricsRegistry`
+(whose lock makes each scrape a consistent point-in-time view); it never
+touches the training thread, the event stream, or the record.
+
+Lifecycle: the harness starts the exporter right after the registry is
+built (so scrapes succeed while the first round is still compiling) and
+closes it in the same ``finally`` that closes the sinks — run end AND
+crash both shut the port down cleanly.  ``port=0`` binds an OS-assigned
+ephemeral port (tests); the bound port is on ``.port`` after
+``start()``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+from .metrics import MetricsRegistry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsExporter:
+    """Background /metrics + /healthz server over one registry."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        port: int = 0,
+        host: str = "0.0.0.0",
+        health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+    ) -> None:
+        self.registry = registry
+        self._requested_port = port
+        self._host = host
+        self._health_fn = health_fn
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.server_address[1] if self._server else None
+
+    def start(self) -> "MetricsExporter":
+        if self._server is not None:
+            return self
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args) -> None:  # silence request spam
+                pass
+
+            def do_GET(self) -> None:
+                if self.path.split("?", 1)[0] == "/metrics":
+                    body = exporter.registry.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path.split("?", 1)[0] == "/healthz":
+                    health = (
+                        exporter._health_fn() if exporter._health_fn
+                        else {"ok": True}
+                    )
+                    body = json.dumps(health).encode()
+                    self.send_response(200 if health.get("ok") else 503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+
+        self._server = ThreadingHTTPServer(
+            (self._host, self._requested_port), Handler
+        )
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="aircomp-metrics-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        server, thread = self._server, self._thread
+        self._server = self._thread = None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def __enter__(self) -> "MetricsExporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,407 @@
+"""Live metrics: an in-process registry fed by the event stream.
+
+Everything observability in this repo flows through schema-versioned
+events (``obs/events.py``) so the jitted round fn never carries a
+telemetry branch.  This module keeps that invariant for LIVE health
+signals: :class:`MetricsSink` is just another :class:`~.sinks.EventSink`
+in the fan-out — it folds each event into a :class:`MetricsRegistry` of
+counters, gauges, and bounded-bucket histograms, and the registry is what
+the scrape endpoint (``obs/exporter.py``) renders and the SLO engine
+(``obs/alerts.py``) evaluates.  Derived state only: killing the metrics
+path changes no event, no record byte, no RNG draw.
+
+Thread-safety: the harness thread writes (one ``emit`` per event) while
+the exporter's HTTP thread reads (``render``/``snapshot``).  One
+registry-wide lock covers both sides, so a scrape can never observe a
+torn histogram (bucket counts that do not sum to the series count).
+
+Cardinality is bounded twice: histograms use FIXED bucket edges (no
+per-value growth), and each metric family holds at most
+:data:`MAX_SERIES` label-sets — overflow label values fold into
+``"__overflow__"`` so a hostile/buggy label (e.g. a per-client id) can
+never grow the registry without bound on an always-on service run.
+
+All metric names carry the ``aircomp_`` prefix.  ``aircomp_events_total
+{kind=...}`` counts every event seen, which is the scrape-vs-stream
+parity anchor the tests pin: at quiesce the scraped counter equals the
+event-stream line count.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .sinks import EventSink
+
+#: per-family label-set cap; the overflow fold keeps scrapes bounded
+MAX_SERIES = 64
+
+#: fixed bucket upper bounds for round-duration histograms (seconds);
+#: the +Inf bucket is implicit
+ROUND_SECONDS_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+#: run-phase gauge values (aircomp_run_phase)
+PHASE_STARTING, PHASE_RUNNING, PHASE_DONE = 0, 1, 2
+
+
+def _labelkey(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Family:
+    """One metric family: name, type, help text, and its label series.
+
+    NOT self-locking — the registry's lock guards every touch, so a
+    family never needs (and never takes) its own.
+    """
+
+    def __init__(self, name: str, kind: str, help_text: str,
+                 buckets: Optional[Tuple[float, ...]] = None) -> None:
+        self.name = name
+        self.kind = kind  # "counter" | "gauge" | "histogram"
+        self.help = help_text
+        self.buckets = tuple(buckets) if buckets else ()
+        # label-key tuple -> float (counter/gauge) or
+        # [bucket_counts list, sum, count] (histogram)
+        self.series: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+
+    def _key(self, labels: Dict[str, str]):
+        key = _labelkey(labels)
+        if key not in self.series and len(self.series) >= MAX_SERIES:
+            key = _labelkey({k: "__overflow__" for k, _ in key}) or key
+        return key
+
+    def inc(self, amount: float, labels: Dict[str, str]) -> None:
+        key = self._key(labels)
+        self.series[key] = self.series.get(key, 0.0) + amount
+
+    def set(self, value: float, labels: Dict[str, str]) -> None:
+        self.series[self._key(labels)] = float(value)
+
+    def observe(self, value: float, labels: Dict[str, str]) -> None:
+        key = self._key(labels)
+        if key not in self.series:
+            self.series[key] = [[0] * len(self.buckets), 0.0, 0]
+        counts, total, n = self.series[key]
+        for i, edge in enumerate(self.buckets):
+            if value <= edge:
+                counts[i] += 1
+                break
+        self.series[key] = [counts, total + value, n + 1]
+
+
+class MetricsRegistry:
+    """Thread-safe counter/gauge/histogram registry with a Prometheus
+    text renderer.  Families are created lazily on first touch; a
+    name reused with a different type raises (the drift would render
+    an invalid exposition)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _family(self, name: str, kind: str, help_text: str,
+                buckets: Optional[Tuple[float, ...]] = None) -> _Family:
+        fam = self._families.get(name)
+        if fam is None:
+            fam = _Family(name, kind, help_text, buckets)
+            self._families[name] = fam
+        elif fam.kind != kind:
+            raise ValueError(
+                f"metric {name!r} registered as {fam.kind}, used as {kind}"
+            )
+        return fam
+
+    def inc(self, name: str, amount: float = 1.0, help_text: str = "",
+            **labels: str) -> None:
+        with self._lock:
+            self._family(name, "counter", help_text).inc(amount, labels)
+
+    def set(self, name: str, value: float, help_text: str = "",
+            **labels: str) -> None:
+        with self._lock:
+            self._family(name, "gauge", help_text).set(value, labels)
+
+    def observe(self, name: str, value: float,
+                buckets: Tuple[float, ...] = ROUND_SECONDS_BUCKETS,
+                help_text: str = "", **labels: str) -> None:
+        with self._lock:
+            self._family(name, "histogram", help_text,
+                         buckets).observe(value, labels)
+
+    def value(self, name: str, **labels: str) -> Optional[float]:
+        """Current scalar of a counter/gauge series (None when the family
+        or series does not exist yet — the alert engine treats absent as
+        rule-specific).  Histograms return their observation count."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return None
+            v = fam.series.get(_labelkey(labels))
+            if v is None:
+                return None
+            return float(v[2]) if fam.kind == "histogram" else float(v)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Point-in-time copy of every series, taken under the lock so a
+        histogram's bucket counts always sum to its count."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for name, fam in sorted(self._families.items()):
+                series = []
+                for key, v in sorted(fam.series.items()):
+                    entry: Dict[str, Any] = {"labels": dict(key)}
+                    if fam.kind == "histogram":
+                        counts, total, n = v
+                        entry.update(
+                            buckets=list(counts), sum=total, count=n
+                        )
+                    else:
+                        entry["value"] = v
+                    series.append(entry)
+                out[name] = {"type": fam.kind, "series": series}
+        return out
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        with self._lock:
+            for name, fam in sorted(self._families.items()):
+                if fam.help:
+                    lines.append(f"# HELP {name} {fam.help}")
+                lines.append(f"# TYPE {name} {fam.kind}")
+                for key, v in sorted(fam.series.items()):
+                    lbl = _render_labels(dict(key))
+                    if fam.kind == "histogram":
+                        counts, total, n = v
+                        cum = 0
+                        for edge, c in zip(fam.buckets, counts):
+                            cum += c
+                            le = _render_labels({**dict(key), "le": _fmt(edge)})
+                            lines.append(f"{name}_bucket{le} {cum}")
+                        inf = _render_labels({**dict(key), "le": "+Inf"})
+                        lines.append(f"{name}_bucket{inf} {n}")
+                        lines.append(f"{name}_sum{lbl} {_fmt(total)}")
+                        lines.append(f"{name}_count{lbl} {n}")
+                    else:
+                        lines.append(f"{name}{lbl} {_fmt(v)}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float) and math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _render_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for k, v in sorted(labels.items()):
+        escaped = str(v).replace("\\", "\\\\").replace('"', '\\"')
+        parts.append(f'{k}="{escaped}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _finite(v) -> bool:
+    try:
+        return math.isfinite(float(v))
+    except (TypeError, ValueError):
+        return False
+
+
+class MetricsSink(EventSink):
+    """Folds the event stream into a :class:`MetricsRegistry`.
+
+    Joins the ordinary sink fan-out, so it sees exactly what the JSONL
+    stream records — including the ``alert`` events the rule engine
+    emits back through the same fan-out (counted like any other kind;
+    no recursion, because counting never emits).
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry if registry is not None else MetricsRegistry()
+
+    # EventSink interface ------------------------------------------------
+    def emit(self, event: Dict[str, Any]) -> None:
+        reg = self.registry
+        kind = event.get("kind", "unknown")
+        reg.inc("aircomp_events_total",
+                help_text="events seen by the metrics sink, by kind",
+                kind=kind)
+        handler = getattr(self, f"_on_{kind}", None)
+        if handler is not None:
+            handler(event)
+
+    # per-kind folds -----------------------------------------------------
+    def _on_run_start(self, e: Dict[str, Any]) -> None:
+        reg = self.registry
+        reg.set("aircomp_run_phase", PHASE_RUNNING,
+                help_text="0=starting 1=running 2=done")
+        reg.set("aircomp_run_start_ts", e.get("ts", 0.0),
+                help_text="run_start wall-clock epoch seconds")
+        if e.get("k") is not None:
+            reg.set("aircomp_clients_k", e["k"],
+                    help_text="configured round size K")
+        if e.get("rounds") is not None:
+            reg.set("aircomp_rounds_scheduled", e["rounds"],
+                    help_text="scheduled round horizon")
+
+    def _on_round(self, e: Dict[str, Any]) -> None:
+        reg = self.registry
+        reg.inc("aircomp_rounds_total", help_text="completed rounds")
+        reg.set("aircomp_round", e.get("round", -1),
+                help_text="last completed round index")
+        reg.set("aircomp_last_round_ts", e.get("ts", 0.0),
+                help_text="last round event wall-clock epoch seconds")
+        for field, gauge in (
+            ("train_loss", "aircomp_train_loss"),
+            ("val_loss", "aircomp_val_loss"),
+            ("val_acc", "aircomp_val_acc"),
+            ("variance", "aircomp_variance"),
+            ("rounds_per_sec", "aircomp_rounds_per_sec"),
+            ("effective_k", "aircomp_effective_k"),
+        ):
+            v = e.get(field)
+            if v is not None and _finite(v):
+                reg.set(gauge, float(v))
+        if any(
+            e.get(f) is not None and not _finite(e.get(f))
+            for f in ("train_loss", "val_loss", "variance")
+        ):
+            reg.inc("aircomp_nonfinite_loss_total",
+                    help_text="rounds with a non-finite loss/variance")
+        if e.get("round_secs") is not None:
+            reg.observe("aircomp_round_seconds", float(e["round_secs"]),
+                        help_text="wall-clock seconds per round")
+        for field, counter in (
+            ("dropped", "aircomp_fault_dropped_total"),
+            ("erased", "aircomp_fault_erased_total"),
+            ("corrupt", "aircomp_fault_corrupt_total"),
+        ):
+            v = e.get(field)
+            if v is not None and _finite(v):
+                reg.inc(counter, float(v),
+                        help_text=f"fault-injection {field} clients, summed")
+        # device-allocator watermarks only: host RSS includes the
+        # interpreter/compiler and must never drive the HBM SLO
+        if str(e.get("mem_source", "")).startswith("device"):
+            if _finite(e.get("bytes_in_use")):
+                reg.set("aircomp_bytes_in_use", float(e["bytes_in_use"]),
+                        help_text="device bytes in use at round end")
+            if _finite(e.get("peak_bytes_in_use")):
+                reg.set("aircomp_peak_bytes_in_use",
+                        float(e["peak_bytes_in_use"]),
+                        help_text="device peak bytes in use")
+
+    def _on_participation(self, e: Dict[str, Any]) -> None:
+        reg = self.registry
+        for field, gauge in (
+            ("available", "aircomp_participation_available"),
+            ("absent", "aircomp_participation_absent"),
+            ("late", "aircomp_participation_late"),
+            ("effective_k", "aircomp_effective_k"),
+        ):
+            if _finite(e.get(field)):
+                reg.set(gauge, float(e[field]),
+                        help_text=f"per-round service {field}")
+        if _finite(e.get("late")):
+            reg.inc("aircomp_late_total", float(e["late"]),
+                    help_text="deadline-missing clients, summed over rounds")
+
+    def _on_rollback(self, e: Dict[str, Any]) -> None:
+        reg = self.registry
+        reg.inc("aircomp_rollbacks_total",
+                help_text="warm-rollback restores (divergence guard trips)")
+        if _finite(e.get("epoch")):
+            reg.set("aircomp_rollback_epoch", float(e["epoch"]),
+                    help_text="current rollback epoch (key salt)")
+
+    def _on_defense(self, e: Dict[str, Any]) -> None:
+        reg = self.registry
+        if _finite(e.get("rung")):
+            reg.set("aircomp_defense_rung", float(e["rung"]),
+                    help_text="current escalation-ladder rung")
+        if _finite(e.get("flagged")):
+            reg.set("aircomp_defense_flagged", float(e["flagged"]),
+                    help_text="clients flagged by the detector this round")
+
+    def _on_client_flag(self, e: Dict[str, Any]) -> None:
+        if e.get("flagged"):
+            self.registry.inc("aircomp_client_flags_total",
+                              help_text="client_flag events with flagged=true")
+
+    def _on_retrace(self, e: Dict[str, Any]) -> None:
+        reg = self.registry
+        counts = e.get("counts") or {}
+        if _finite(counts.get("round_fn")):
+            reg.set("aircomp_retrace_round_lowerings",
+                    float(counts["round_fn"]),
+                    help_text="round_fn lowerings this run (SLO: exactly 1)")
+        reg.set("aircomp_retrace_steady_state_ok",
+                1.0 if e.get("steady_state_ok") else 0.0,
+                help_text="1 when the steady-state retrace audit passed")
+
+    def _on_run_end(self, e: Dict[str, Any]) -> None:
+        reg = self.registry
+        reg.set("aircomp_run_phase", PHASE_DONE,
+                help_text="0=starting 1=running 2=done")
+        if _finite(e.get("rounds_per_sec")):
+            reg.set("aircomp_rounds_per_sec", float(e["rounds_per_sec"]))
+        mem = e.get("memory") or {}
+        if _finite(mem.get("modeled_peak_bytes")):
+            reg.set("aircomp_hbm_modeled_peak_bytes",
+                    float(mem["modeled_peak_bytes"]),
+                    help_text="obs/hbm.py analytic peak model")
+            # the watermark SLO ratio only exists for device-sourced
+            # measurements — host RSS would trip it on every CPU run
+            if (str(mem.get("source", "")).startswith("device")
+                    and _finite(mem.get("peak_bytes_in_use"))
+                    and float(mem["modeled_peak_bytes"]) > 0):
+                reg.set(
+                    "aircomp_hbm_watermark_ratio",
+                    float(mem["peak_bytes_in_use"])
+                    / float(mem["modeled_peak_bytes"]),
+                    help_text="measured device peak / modeled peak",
+                )
+
+    def _on_alert(self, e: Dict[str, Any]) -> None:
+        if e.get("firing"):
+            self.registry.inc(
+                "aircomp_alerts_total",
+                help_text="alert rule rising edges",
+                rule=str(e.get("rule", "?")),
+                severity=str(e.get("severity", "?")),
+            )
+
+    # health -------------------------------------------------------------
+    def health(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The /healthz body: run phase, last-round age, rollback epoch."""
+        import time as _time
+
+        reg = self.registry
+        phase_num = reg.value("aircomp_run_phase")
+        phase = {None: "starting", float(PHASE_STARTING): "starting",
+                 float(PHASE_RUNNING): "running",
+                 float(PHASE_DONE): "done"}.get(phase_num, "running")
+        last_ts = reg.value("aircomp_last_round_ts")
+        age = None
+        if last_ts is not None:
+            age = round((now if now is not None else _time.time()) - last_ts, 3)
+        last_round = reg.value("aircomp_round")
+        epoch = reg.value("aircomp_rollback_epoch")
+        return {
+            "ok": True,
+            "phase": phase,
+            "last_round": None if last_round is None else int(last_round),
+            "last_round_age_secs": age,
+            "rollback_epoch": 0 if epoch is None else int(epoch),
+            "alerts_firing": int(reg.value("aircomp_alerts_firing") or 0),
+        }

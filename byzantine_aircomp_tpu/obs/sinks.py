@@ -44,6 +44,23 @@ def _dumps(event: Dict[str, Any]) -> str:
     return json.dumps(event, separators=(",", ":"), default=str)
 
 
+def rotated_segments(path: str) -> List[str]:
+    """Rotated siblings of a live stream ``path``, oldest first.
+
+    :class:`JsonlSink` size rotation renames the live file to
+    ``<path>.0001``, ``<path>.0002``, ... — an extension that can never
+    match the ``*.events.jsonl`` glob the analysis tools use to discover
+    RUNS, so a rotated run still presents exactly one live path and the
+    loaders pull the segments in via this helper."""
+    import glob
+    import os
+
+    return sorted(
+        p for p in glob.glob(path + ".[0-9][0-9][0-9][0-9]")
+        if os.path.isfile(p)
+    )
+
+
 class EventSink:
     """Interface: ``emit`` one event dict; ``close`` flushes/releases."""
 
@@ -114,15 +131,30 @@ class JsonlSink(EventSink):
     empty/absent at construction — writers that lead with a header line
     (benchmarks/trajectory.py) key on it instead of re-implementing the
     ``tell() == 0`` dance.
+
+    ``rotate_mb`` > 0 caps the live file: once a write carries it past
+    the threshold it is renamed to the next ``<path>.NNNN`` segment and
+    a fresh live file opens.  The in-memory ``seq`` counter keeps
+    running across rotations (and a resumed sink counts lines across
+    ALL segments), so the multi-segment stream keeps one monotonic
+    ``seq`` envelope and the seq-ordered loaders read it unchanged.
+    Always-on service runs stay bounded per file instead of growing one
+    unbounded JSONL.
     """
 
-    def __init__(self, path: str, atomic: bool = False) -> None:
+    def __init__(
+        self, path: str, atomic: bool = False, rotate_mb: float = 0.0
+    ) -> None:
         import os
 
         self.path = path
         self._atomic = atomic
         self._failed = False
-        self.fresh = not os.path.exists(path) or os.path.getsize(path) == 0
+        self._rotate_bytes = int(rotate_mb * 2**20)
+        segments = rotated_segments(path) if not atomic else []
+        self.fresh = (
+            not os.path.exists(path) or os.path.getsize(path) == 0
+        ) and not segments
         if atomic:
             self._rows: List[str] = []
             self._fh: Optional[TextIO] = None
@@ -130,10 +162,15 @@ class JsonlSink(EventSink):
             self._fh = io_lib.open_append(path)
             if not self.fresh:
                 # resume/append: continue ``seq`` from the existing line
-                # count so the file stays totally ordered across restarts
+                # count — across rotated segments — so the stream stays
+                # totally ordered across restarts
                 try:
-                    with open(path, "r") as fh:
-                        self._seq = sum(1 for _ in fh)
+                    n = 0
+                    for p in segments + [path]:
+                        if os.path.exists(p):
+                            with open(p, "r") as fh:
+                                n += sum(1 for _ in fh)
+                    self._seq = n
                 except OSError:
                     pass
 
@@ -148,6 +185,11 @@ class JsonlSink(EventSink):
                 assert self._fh is not None
                 self._fh.write(line + "\n")
                 self._fh.flush()
+                if (
+                    self._rotate_bytes
+                    and self._fh.tell() >= self._rotate_bytes
+                ):
+                    self._rotate()
         except OSError as e:  # disk full mid-run: degrade, don't kill training
             self._failed = True
             print(
@@ -155,6 +197,19 @@ class JsonlSink(EventSink):
                 "further events dropped",
                 file=sys.stderr,
             )
+
+    def _rotate(self) -> None:
+        """Rename the live file to the next numbered segment and reopen."""
+        import os
+
+        assert self._fh is not None
+        self._fh.close()
+        existing = rotated_segments(self.path)
+        nxt = 1
+        if existing:
+            nxt = int(existing[-1].rsplit(".", 1)[1]) + 1
+        os.replace(self.path, f"{self.path}.{nxt:04d}")
+        self._fh = io_lib.open_append(self.path)
 
     def flush(self) -> None:
         if self._fh is not None and not self._failed:

@@ -257,6 +257,7 @@ def test_config_hash_off_matches_predefense_formula():
         "obs_dir", "obs_stdout", "log_file", "quiet",
         "profile_rounds", "hbm_warn_factor",
         "forensics", "forensics_top", "flight_window",
+        "metrics", "metrics_port", "alerts", "obs_rotate_mb",
     )
     items = sorted(
         (f.name, repr(getattr(cfg, f.name)))

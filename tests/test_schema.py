@@ -33,12 +33,15 @@ def _fingerprint(required: dict, key_map: dict) -> str:
 
 
 # one golden row per published schema version.  To CHANGE the schema:
-# bump SCHEMA_VERSION in obs/events.py, run the test, and append the new
-# (version, fingerprint) pair here — the diff then shows reviewers
-# exactly which version introduced which fields.  Editing an EXISTING
-# row is the drift this gate exists to catch.
+# bump SCHEMA_VERSION in obs/events.py, run
+# ``python tests/test_schema.py --regen`` (it prints this row ready to
+# paste plus the docs/OBSERVABILITY.md table stubs the new kinds need),
+# and append the new (version, fingerprint) pair here — the diff then
+# shows reviewers exactly which version introduced which fields.
+# Editing an EXISTING row is the drift this gate exists to catch.
 GOLDEN = {
     2: "a5033a62e61ad318",
+    3: "b654d31431900f5b",
 }
 
 
@@ -96,3 +99,64 @@ def test_seq_is_optional_in_validation():
     assert "seq" not in e
     obs_lib.validate_event(e)
     obs_lib.validate_event({**e, "seq": 17})
+
+
+def regen() -> int:
+    """The schema-bump workflow, mechanized: print the GOLDEN row the
+    current code requires plus the docs/OBSERVABILITY.md table stubs for
+    any kind the schema table does not document yet.
+
+        python tests/test_schema.py --regen
+
+    Paste the row into GOLDEN above (append — editing an existing row is
+    the drift this gate exists to catch) and fill in the doc stubs; the
+    four tests in this module then pass again."""
+    version = events_lib.SCHEMA_VERSION
+    fp = _fingerprint(events_lib._REQUIRED, events_lib.REFERENCE_KEY_MAP)
+    print(f"SCHEMA_VERSION = {version}")
+    print(f"fingerprint    = {fp}")
+    if GOLDEN.get(version) == fp:
+        print("GOLDEN row     : already present and matching — nothing to do")
+    else:
+        if version in GOLDEN:
+            print(
+                f"WARNING: GOLDEN[{version}] = {GOLDEN[version]!r} does not "
+                "match — required fields changed WITHOUT a version bump. "
+                "Bump SCHEMA_VERSION in obs/events.py first, then re-run."
+            )
+            return 1
+        print("append to tests/test_schema.py::GOLDEN:")
+        print(f"    {version}: \"{fp}\",")
+    doc = open(os.path.join(REPO, "docs", "OBSERVABILITY.md")).read()
+    documented = set(re.findall(r"^\|\s*`(\w+)`\s*\|", doc, re.MULTILINE))
+    missing = sorted(set(events_lib._REQUIRED) - documented)
+    if missing:
+        print("\ndocs/OBSERVABILITY.md schema-table rows still needed:")
+        for kind in missing:
+            fields = ", ".join(f"`{f}`" for f in events_lib._REQUIRED[kind])
+            print(f"| `{kind}` | {fields} | TODO: describe |")
+    m = re.search(r"SCHEMA_VERSION`, currently (\d+)", doc)
+    if m and int(m.group(1)) != version:
+        print(
+            f"\ndocs/OBSERVABILITY.md states version {m.group(1)} — update "
+            f"the '``SCHEMA_VERSION``, currently {m.group(1)}' sentence "
+            f"to {version}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        description="schema-drift gate helper (the tests run under pytest)"
+    )
+    ap.add_argument(
+        "--regen", action="store_true",
+        help="print the GOLDEN fingerprint row and missing doc-table rows "
+        "for the current schema",
+    )
+    if ap.parse_args().regen:
+        sys.exit(regen())
+    ap.error("nothing to do: pass --regen (tests run via pytest)")

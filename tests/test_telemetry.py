@@ -1,0 +1,609 @@
+"""Live service telemetry: metrics registry, scrape endpoint, SLO alert
+engine, stream rotation, and the live tail.
+
+The acceptance bar (ISSUE 10): a seeded ``--service on`` run with alerts
+enabled fires the rollback-rate alert exactly when the divergence guard
+trips (and nothing on the healthy control); a live ``/metrics`` scrape
+during the run returns counters matching the event stream at run end;
+the record is bit-identical with every new knob on vs off; and the round
+fn still lowers exactly once with metrics on (the ``lowering`` tests
+double as CI retrace-gate members via ``-k "retrace or lowering"``).
+"""
+
+import io
+import json
+import pickle
+import threading
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu import obs as obs_lib
+from byzantine_aircomp_tpu.obs import alerts as alerts_lib
+from byzantine_aircomp_tpu.obs import metrics as metrics_lib
+from byzantine_aircomp_tpu.fed.config import FedConfig
+
+
+# ----------------------------------------------------------- registry
+
+
+def test_registry_counter_gauge_value():
+    reg = metrics_lib.MetricsRegistry()
+    reg.inc("aircomp_events_total", kind="round")
+    reg.inc("aircomp_events_total", 2.0, kind="round")
+    reg.inc("aircomp_events_total", kind="span")
+    reg.set("aircomp_round", 7)
+    assert reg.value("aircomp_events_total", kind="round") == 3.0
+    assert reg.value("aircomp_events_total", kind="span") == 1.0
+    assert reg.value("aircomp_round") == 7.0
+    # absent family / absent series both read as None (the alert engine
+    # keys rule-specific behavior on the distinction vs 0.0)
+    assert reg.value("aircomp_nope") is None
+    assert reg.value("aircomp_events_total", kind="nope") is None
+
+
+def test_registry_histogram_render_is_cumulative():
+    reg = metrics_lib.MetricsRegistry()
+    for v in (0.02, 0.02, 0.3, 100.0):
+        reg.observe("aircomp_round_seconds", v)
+    assert reg.value("aircomp_round_seconds") == 4  # histogram -> count
+    text = reg.render()
+    # exposition format 0.0.4: le buckets are CUMULATIVE, +Inf == count
+    assert 'aircomp_round_seconds_bucket{le="0.025"} 2' in text
+    assert 'aircomp_round_seconds_bucket{le="0.5"} 3' in text
+    assert 'aircomp_round_seconds_bucket{le="+Inf"} 4' in text
+    assert "aircomp_round_seconds_count 4" in text
+    assert "aircomp_round_seconds_sum" in text
+    assert "# TYPE aircomp_round_seconds histogram" in text
+    snap = reg.snapshot()["aircomp_round_seconds"]["series"][0]
+    assert sum(snap["buckets"]) + 1 == snap["count"]  # 100.0 -> +Inf only
+
+
+def test_registry_label_cardinality_overflow_fold():
+    reg = metrics_lib.MetricsRegistry()
+    for i in range(metrics_lib.MAX_SERIES + 40):
+        reg.inc("aircomp_events_total", kind=f"hostile_{i}")
+    snap = reg.snapshot()["aircomp_events_total"]["series"]
+    # a hostile/buggy label can never grow the family past the cap (+1
+    # for the fold target itself)
+    assert len(snap) <= metrics_lib.MAX_SERIES + 1
+    assert reg.value("aircomp_events_total", kind="__overflow__") == 40.0
+
+
+def test_registry_type_conflict_raises():
+    reg = metrics_lib.MetricsRegistry()
+    reg.inc("aircomp_x")
+    with pytest.raises(ValueError, match="registered as counter"):
+        reg.set("aircomp_x", 1.0)
+
+
+def test_metrics_sink_folds_the_event_stream():
+    sink = obs_lib.MetricsSink()
+    reg = sink.registry
+    sink.emit(obs_lib.make_event("run_start", k=8, rounds=4))
+    sink.emit(obs_lib.make_event(
+        "participation", round=0, available=7, absent=1, late=2,
+        effective_k=6,
+    ))
+    sink.emit(obs_lib.make_event(
+        "round", round=0, val_loss=0.5, val_acc=0.8, variance=1.0,
+        round_secs=0.02, rounds_per_sec=50.0,
+    ))
+    sink.emit(obs_lib.make_event(
+        "rollback", round=1, restored_round=0, reason="non_finite", epoch=1,
+    ))
+    sink.emit(obs_lib.make_event(
+        "round", round=1, val_loss=float("nan"), val_acc=0.1, variance=1.0,
+    ))
+    assert reg.value("aircomp_clients_k") == 8.0
+    assert reg.value("aircomp_rounds_total") == 2.0
+    assert reg.value("aircomp_effective_k") == 6.0
+    assert reg.value("aircomp_late_total") == 2.0
+    assert reg.value("aircomp_rollbacks_total") == 1.0
+    assert reg.value("aircomp_rollback_epoch") == 1.0
+    assert reg.value("aircomp_nonfinite_loss_total") == 1.0
+    # the NaN never lands in the gauge (last finite value wins)
+    assert reg.value("aircomp_val_loss") == 0.5
+    assert reg.value("aircomp_events_total", kind="round") == 2.0
+    h = sink.health(now=1e12)
+    assert h["ok"] and h["phase"] == "running"
+    assert h["last_round"] == 1 and h["rollback_epoch"] == 1
+
+
+# ----------------------------------------------------------- exporter
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def test_exporter_serves_metrics_and_healthz():
+    sink = obs_lib.MetricsSink()
+    sink.emit(obs_lib.make_event("run_start", k=4, rounds=2))
+    sink.emit(obs_lib.make_event("round", round=0, val_loss=0.5,
+                                 val_acc=0.8, variance=1.0))
+    with obs_lib.MetricsExporter(
+        sink.registry, port=0, host="127.0.0.1", health_fn=sink.health
+    ) as exp:
+        base = f"http://127.0.0.1:{exp.port}"
+        status, body = _get(base + "/metrics")
+        assert status == 200
+        assert 'aircomp_events_total{kind="round"} 1' in body
+        assert "aircomp_rounds_total 1" in body
+        status, body = _get(base + "/healthz")
+        assert status == 200
+        health = json.loads(body)
+        assert health["ok"] and health["last_round"] == 0
+        with pytest.raises(urllib.error.HTTPError):
+            _get(base + "/nope")
+    assert exp.port is None  # closed: port released
+
+
+# ----------------------------------------------------------- rotation
+
+
+def test_jsonl_rotation_keeps_monotonic_seq(tmp_path):
+    p = str(tmp_path / "run.events.jsonl")
+    # ~1 KiB cap: each event line is >60 bytes, so 50 events rotate
+    sink = obs_lib.JsonlSink(p, rotate_mb=0.001)
+    for i in range(50):
+        sink.emit(obs_lib.make_event("round", round=i, val_loss=0.5,
+                                     val_acc=0.8, variance=1.0))
+    sink.close()
+    segments = obs_lib.sinks.rotated_segments(p)
+    assert len(segments) >= 2
+    # segment names must NOT match the run-discovery glob
+    assert all(not s.endswith(".events.jsonl") for s in segments)
+    rows = []
+    for f in segments + [p]:
+        rows.extend(json.loads(l) for l in open(f))
+    assert [e["seq"] for e in rows] == list(range(50))
+    # a reopened sink resumes the counter across ALL segments
+    s2 = obs_lib.JsonlSink(p)
+    assert not s2.fresh
+    s2.emit(obs_lib.make_event("round", round=50, val_loss=0.5,
+                               val_acc=0.8, variance=1.0))
+    s2.close()
+    assert json.loads(open(p).readlines()[-1])["seq"] == 50
+
+
+def test_rotated_stream_loads_as_one_seq_ordered_stream(tmp_path):
+    from byzantine_aircomp_tpu.analysis.defense_trace import load_events
+
+    p = str(tmp_path / "run.events.jsonl")
+    sink = obs_lib.JsonlSink(p, rotate_mb=0.001)
+    sink.emit(obs_lib.make_event("run_start", k=4, rounds=40,
+                                 start_round=0))
+    for i in range(40):
+        sink.emit(obs_lib.make_event("round", round=i, val_loss=0.5,
+                                     val_acc=0.8, variance=1.0))
+    sink.emit(obs_lib.make_event("run_end", elapsed_secs=1.0,
+                                 rounds_run=40))
+    sink.close()
+    assert obs_lib.sinks.rotated_segments(p)
+    events = load_events(p)
+    # the loaders see a rotated run as ONE stream: every event, in the
+    # sink's monotonic seq order, run_start first and run_end last
+    assert len(events) == 42
+    assert [e["seq"] for e in events] == list(range(42))
+    assert events[0]["kind"] == "run_start"
+    assert events[-1]["kind"] == "run_end"
+    assert [e["round"] for e in events if e["kind"] == "round"] == list(
+        range(40)
+    )
+
+
+# -------------------------------------------------------- concurrency
+
+
+def test_concurrent_scrape_no_torn_histograms():
+    sink = obs_lib.MetricsSink()
+    reg = sink.registry
+    n_events = 400
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        while not stop.is_set():
+            for name, fam in reg.snapshot().items():
+                if fam["type"] != "histogram":
+                    continue
+                for series in fam["series"]:
+                    if sum(series["buckets"]) > series["count"]:
+                        torn.append((name, series))
+            reg.render()
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        for i in range(n_events):
+            sink.emit(obs_lib.make_event(
+                "round", round=i, val_loss=0.5, val_acc=0.8,
+                variance=1.0, round_secs=0.001 * (i % 70),
+            ))
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not torn, f"torn histogram reads: {torn[:3]}"
+    # quiesce parity: the scraped counters equal the event stream
+    assert reg.value("aircomp_events_total", kind="round") == n_events
+    assert reg.value("aircomp_rounds_total") == n_events
+    assert reg.value("aircomp_round_seconds") == n_events
+
+
+# ------------------------------------------------ config / CLI surface
+
+
+def _cfg(rounds, **kw):
+    base = dict(
+        dataset="mnist", honest_size=6, byz_size=0, rounds=rounds,
+        display_interval=3, batch_size=16, agg="mean", eval_train=False,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def test_telemetry_config_validation(tmp_path):
+    _cfg(2, metrics="on", metrics_port=9105, alerts="default",
+         obs_rotate_mb=64.0, obs_dir="/tmp/o").validate()
+    with pytest.raises(AssertionError):
+        _cfg(2, metrics="sometimes").validate()
+    with pytest.raises(AssertionError):
+        _cfg(2, metrics_port=70000).validate()
+    # fault-knob contract: a rotation cap with no stream to rotate would
+    # silently do nothing
+    with pytest.raises(AssertionError):
+        _cfg(2, obs_rotate_mb=64.0).validate()
+    # a custom rules file is parsed at validate time, not at round N
+    bad = tmp_path / "rules.json"
+    bad.write_text(json.dumps([{"name": "x", "metric": "m", "op": "nope",
+                                "value": 1}]))
+    with pytest.raises(ValueError, match="op must be"):
+        _cfg(2, alerts=str(bad)).validate()
+    good = tmp_path / "ok.json"
+    good.write_text(json.dumps(
+        [{"name": "x", "metric": "aircomp_round", "op": "gt", "value": 1}]
+    ))
+    _cfg(2, alerts=str(good)).validate()
+
+
+def test_telemetry_knobs_do_not_change_config_hash():
+    from byzantine_aircomp_tpu.fed import harness
+
+    a = harness.config_hash(_cfg(3))
+    b = harness.config_hash(
+        _cfg(3, metrics="on", metrics_port=9105, alerts="default",
+             obs_rotate_mb=64.0, obs_dir="/tmp/o")
+    )
+    # output-only knobs must not split checkpoint identity
+    assert a == b
+    assert "metrics" not in harness.run_title(
+        _cfg(3, metrics="on", alerts="default")
+    )
+
+
+def test_cli_telemetry_flags_parse():
+    from byzantine_aircomp_tpu import cli
+
+    p = cli.build_parser()
+    args = p.parse_args(
+        ["--metrics", "on", "--metrics-port", "9105",
+         "--alerts", "default", "--obs-rotate-mb", "64",
+         "--obs-dir", "/tmp/o"]
+    )
+    cfg = cli.config_from_args(args)
+    assert cfg.metrics == "on" and cfg.metrics_port == 9105
+    assert cfg.alerts == "default" and cfg.obs_rotate_mb == 64.0
+    dflt = cli.config_from_args(p.parse_args([]))
+    assert dflt.metrics == "off" and dflt.metrics_port == 0
+    assert dflt.alerts == "off" and dflt.obs_rotate_mb == 0.0
+
+
+def test_alerts_self_check_passes(capsys):
+    assert alerts_lib.self_check() == 0
+    out = capsys.readouterr().out
+    assert "self-check: ok" in out
+
+
+# ------------------------------------------------- end-to-end harness
+
+
+@pytest.fixture
+def synthetic_mnist(monkeypatch):
+    import byzantine_aircomp_tpu.data.datasets as dl
+
+    orig = dl.load
+    monkeypatch.setattr(
+        dl, "load",
+        lambda name, **kw: orig(name, synthetic_train=600, synthetic_val=200),
+    )
+
+
+def _read_events(obs_dir, cfg):
+    from byzantine_aircomp_tpu.analysis.defense_trace import load_events
+    from byzantine_aircomp_tpu.fed import harness
+
+    return load_events(
+        obs_lib.events_path(str(obs_dir), harness.ckpt_title(cfg))
+    )
+
+
+def test_telemetry_knobs_record_bitwise_identical(tmp_path, synthetic_mnist):
+    from byzantine_aircomp_tpu.fed import harness
+
+    plain = harness.run(_cfg(3), record_in_file=False)
+    observed = harness.run(
+        _cfg(3, obs_dir=str(tmp_path / "obs"), metrics="on",
+             alerts="default", obs_rotate_mb=0.001),
+        record_in_file=False,
+    )
+    # roundsPerSec is wall clock — nondeterministic between ANY two runs
+    plain.pop("roundsPerSec")
+    observed.pop("roundsPerSec")
+    assert pickle.dumps(plain) == pickle.dumps(observed)
+
+
+def test_metrics_alerts_resident_single_lowering(tmp_path, synthetic_mnist):
+    """CI retrace-gate member: the metrics registry and alert engine are
+    host-side folds over the event stream — with both on, the resident
+    round fn still lowers exactly once."""
+    from byzantine_aircomp_tpu.fed import harness
+
+    cfg = _cfg(3, obs_dir=str(tmp_path / "obs"), metrics="on",
+               alerts="default")
+    harness.run(cfg, record_in_file=False)
+    events = _read_events(tmp_path / "obs", cfg)
+    (ret,) = [e for e in events if e["kind"] == "retrace"]
+    assert ret["counts"]["round_fn"] == 1 and ret["steady_state_ok"]
+    # a healthy run fires nothing, and the registry dump closes the
+    # stream (the artifact dashboards and --gate read post-hoc)
+    assert [e for e in events if e["kind"] == "alert"] == []
+    assert events[-1]["kind"] == "metrics_snapshot"
+    snap = events[-1]["metrics"]
+    n_rounds = snap["aircomp_rounds_total"]["series"][0]["value"]
+    assert n_rounds == len([e for e in events if e["kind"] == "round"])
+    assert events[-1]["alerts"]["total_fired"] == 0
+    # alert gate on the finished stream: exit 0
+    from byzantine_aircomp_tpu.fed import harness as h
+
+    path = obs_lib.events_path(str(tmp_path / "obs"), h.ckpt_title(cfg))
+    assert alerts_lib.gate(path, fail_on="warn") == 0
+
+
+def test_metrics_service_streamed_single_lowering(tmp_path, synthetic_mnist):
+    """CI retrace-gate member: metrics + alerts on the service path with
+    cohort streaming — the most dynamic execution path must stay
+    shape-stable (one lowering) with the full telemetry stack attached."""
+    from byzantine_aircomp_tpu.fed import harness
+
+    cfg = _cfg(
+        3, agg="trimmed_mean", service="on", population=18,
+        churn_arrival=0.05, churn_departure=0.02, straggler_prob=0.2,
+        cohort_size=3, obs_dir=str(tmp_path / "obs"), metrics="on",
+        alerts="default", obs_rotate_mb=0.001,
+    )
+    harness.run(cfg, record_in_file=False)
+    events = _read_events(tmp_path / "obs", cfg)
+    (ret,) = [e for e in events if e["kind"] == "retrace"]
+    assert ret["counts"]["round_fn"] == 1 and ret["steady_state_ok"]
+    # rotation happened (tiny cap) and the loader still saw one ordered
+    # stream ending in the registry dump
+    path = obs_lib.events_path(str(tmp_path / "obs"), harness.ckpt_title(cfg))
+    assert obs_lib.sinks.rotated_segments(path)
+    assert [e["seq"] for e in events] == sorted(e["seq"] for e in events)
+    assert events[-1]["kind"] == "metrics_snapshot"
+    parts = [e for e in events if e["kind"] == "participation"]
+    assert len(parts) == 3
+
+
+# ------------------------------------------ alert acceptance (service)
+
+
+def _service_cfg(**kw):
+    base = dict(
+        honest_size=6, byz_size=0, rounds=4, display_interval=2,
+        batch_size=16, agg="trimmed_mean", eval_train=False,
+        service="on", population=18, churn_arrival=0.05,
+        churn_departure=0.02, straggler_prob=0.0, rollback_max=2,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _telemetry_obs():
+    mem = obs_lib.MemorySink()
+    registry = metrics_lib.MetricsRegistry()
+    msink = obs_lib.MetricsSink(registry)
+    engine = obs_lib.AlertEngine(obs_lib.load_rules("default"), registry)
+    obs = obs_lib.Observability(
+        obs_lib.MultiSink([mem, msink]),
+        registry=registry, metrics_sink=msink, alert_engine=engine,
+    )
+    return obs, mem, registry
+
+
+def test_service_alert_fires_exactly_on_divergence(synthetic_mnist):
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+
+    ds = data_lib.load("mnist")
+    tr = FedTrainer(_service_cfg(), dataset=ds)
+    obs, mem, registry = _telemetry_obs()
+
+    corrupted = []
+
+    def corrupt_once(round_idx, trainer):
+        # poison AFTER the snapshot: the NEXT round diverges non-finite
+        # and the divergence guard restores + re-runs it
+        if round_idx == 2 and not corrupted:
+            corrupted.append(round_idx)
+            trainer.flat_params = trainer.flat_params * jnp.float32(np.nan)
+
+    paths = tr.train(checkpoint_fn=corrupt_once, obs=obs)
+    assert np.isfinite(paths["valLossPath"]).all()
+    (rb,) = mem.by_kind("rollback")
+    alerts = mem.by_kind("alert")
+    # the acceptance bar: the rollback-rate page fires EXACTLY when the
+    # guard trips — one rising edge, at the re-run of the tripped round,
+    # and no other rule makes noise
+    assert len(alerts) == 1
+    (ev,) = alerts
+    assert ev["rule"] == "rollback_rate" and ev["severity"] == "page"
+    assert ev["firing"] is True and ev["round"] == rb["round"]
+    assert registry.value("aircomp_alerts_firing") == 1.0
+    assert registry.value("aircomp_rollbacks_total") == 1.0
+    assert registry.value(
+        "aircomp_alerts_total", rule="rollback_rate", severity="page"
+    ) == 1.0
+
+
+def test_service_alert_quiet_on_healthy_control(synthetic_mnist):
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+
+    ds = data_lib.load("mnist")
+    tr = FedTrainer(_service_cfg(), dataset=ds)
+    obs, mem, registry = _telemetry_obs()
+    tr.train(obs=obs)
+    assert mem.by_kind("rollback") == []
+    assert mem.by_kind("alert") == []
+    assert registry.value("aircomp_alerts_firing") == 0.0
+
+
+def test_live_scrape_matches_event_stream(synthetic_mnist):
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+
+    ds = data_lib.load("mnist")
+    tr = FedTrainer(_service_cfg(rounds=3), dataset=ds)
+    obs, mem, registry = _telemetry_obs()
+    exp = obs_lib.MetricsExporter(
+        registry, port=0, host="127.0.0.1",
+        health_fn=obs.metrics_sink.health,
+    ).start()
+    obs.exporter = exp
+    mid_run = {}
+
+    def scrape(round_idx, trainer):
+        if round_idx == 1 and not mid_run:
+            status, body = _get(f"http://127.0.0.1:{exp.port}/metrics")
+            assert status == 200
+            mid_run["body"] = body
+            status, hz = _get(f"http://127.0.0.1:{exp.port}/healthz")
+            health = json.loads(hz)
+            # driving train() directly skips the harness run_start event,
+            # so phase stays "starting"; the round telemetry is live
+            assert health["ok"] and health["last_round"] == 0
+
+    try:
+        tr.train(checkpoint_fn=scrape, obs=obs)
+    finally:
+        obs.close()
+    # scraped WHILE running: the mid-run exposition already carried the
+    # live counters
+    assert "aircomp_rounds_total" in mid_run["body"]
+    assert 'aircomp_events_total{kind="round"}' in mid_run["body"]
+    # quiesce parity: every counter equals the event stream it folded
+    kinds = {}
+    for e in mem.events:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    for kind, n in kinds.items():
+        assert registry.value("aircomp_events_total", kind=kind) == n, kind
+    assert registry.value("aircomp_rounds_total") == kinds["round"]
+    assert obs.exporter is None  # close() released the port
+
+
+# ----------------------------------------------------------- live tail
+
+
+def _tail_events():
+    mk = obs_lib.make_event
+    return [
+        mk("run_start", title="t", backend="cpu", k=8, byz=0, rounds=3,
+           agg="trimmed_mean", defense="off", service="on",
+           start_round=0),
+        mk("participation", round=0, available=8, absent=0, late=1,
+           effective_k=7),
+        mk("round", round=0, val_loss=0.5, val_acc=0.8, variance=1.0,
+           rounds_per_sec=12.0),
+        mk("rollback", round=1, restored_round=0, reason="non_finite",
+           epoch=1),
+        mk("alert", round=1, rule="rollback_rate", severity="page",
+           value=1.0, threshold=1.0, firing=True),
+        mk("round", round=1, val_loss=0.4, val_acc=0.82, variance=1.0),
+        mk("alert", round=2, rule="rollback_rate", severity="page",
+           value=0.0, threshold=1.0, firing=False),
+        mk("round", round=2, val_loss=0.3, val_acc=0.85, variance=1.0),
+        mk("run_end", elapsed_secs=3.0, rounds_run=3, rounds_per_sec=1.0,
+           final_val_acc=0.85),
+    ]
+
+
+def test_tail_renderer_folds_rounds_rollbacks_alerts():
+    from byzantine_aircomp_tpu.analysis import tail as tail_lib
+
+    out = io.StringIO()
+    r = tail_lib.Renderer(out=out)
+    for e in _tail_events():
+        r.feed(e)
+    text = out.getvalue()
+    lines = text.splitlines()
+    assert lines[0].startswith("== run t")
+    # buffered per-round context lands on the round line
+    assert "effK 7/8" in lines[1] and "late 1" in lines[1]
+    assert any(l.startswith("!! ROLLBACK at round 1") for l in lines)
+    assert any(l.startswith("!! ALERT page: rollback_rate") for l in lines)
+    # the firing alert annotates round 1's line, and clears off round 2's
+    round1 = [l for l in lines if l.startswith("r     1")]
+    assert round1 and "ALERTS rollback_rate[page]" in round1[0]
+    round2 = [l for l in lines if l.startswith("r     2")]
+    assert round2 and "ALERTS" not in round2[0]
+    assert any(l.startswith("ok ALERT cleared") for l in lines)
+    assert lines[-1].startswith("== run end: 3 rounds")
+    assert "1 rollback(s)" in lines[-1]
+
+
+def test_tail_once_replays_rotated_stream(tmp_path, capsys):
+    from byzantine_aircomp_tpu.analysis import tail as tail_lib
+
+    p = str(tmp_path / "run.events.jsonl")
+    sink = obs_lib.JsonlSink(p, rotate_mb=0.001)
+    for e in _tail_events():
+        sink.emit(e)
+    sink.close()
+    assert obs_lib.sinks.rotated_segments(p)
+    # directory target: the tail discovers the newest live stream and
+    # replays the rotated segments before it
+    assert tail_lib.main([str(tmp_path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert out.splitlines()[0].startswith("== run t")
+    assert len([l for l in out.splitlines() if l.startswith("r ")]) == 3
+    assert "== run end" in out
+
+
+def test_tail_follow_picks_up_appends(tmp_path):
+    from byzantine_aircomp_tpu.analysis import tail as tail_lib
+
+    p = str(tmp_path / "run.events.jsonl")
+    events = _tail_events()
+    sink = obs_lib.JsonlSink(p)
+    sink.emit(events[0])
+    out = io.StringIO()
+    r = tail_lib.Renderer(out=out)
+
+    def writer():
+        for e in events[1:]:
+            sink.emit(e)
+        sink.close()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    tail_lib.follow(str(tmp_path), r, interval=0.05, max_seconds=3.0)
+    t.join()
+    text = out.getvalue()
+    # backfill + live appends both rendered
+    assert text.startswith("== run t")
+    assert "== run end" in text
