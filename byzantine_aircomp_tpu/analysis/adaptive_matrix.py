@@ -49,6 +49,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -270,12 +271,222 @@ def simulate_cell(
     return cell
 
 
+def simulate_cells_batched(
+    attack_name: str,
+    modes: List[str],
+    *,
+    iters: int = 40,
+    onset: int = 10,
+    stop: Optional[int] = 30,
+    ladder: Tuple[str, ...] = ("mean", "trimmed_mean", "multi_krum"),
+    det: Optional[defense_lib.DetectorParams] = None,
+    pol: Optional[defense_lib.PolicyParams] = None,
+    seed: int = 0,
+) -> Dict[str, Dict[str, object]]:
+    """Every requested mode of one (attack, ladder) family from ONE
+    jitted ``lax.scan`` — the ``--batched`` kernel.
+
+    The eager :func:`simulate_cell` pays per-iteration dispatch (dozens
+    of host round-trips per iteration, per cell).  But the mode axis is
+    redundant work: the aggregation output never feeds back into the
+    synthetic stack, so the detector/policy trajectory is identical for
+    ``monitor`` and ``adaptive`` (and unused by ``off``).  One traced
+    scan therefore computes the flag/rung/suspicion trajectories once,
+    plus BOTH aggregate trajectories (rung 0 for off/monitor, the live
+    ``lax.switch`` rung for adaptive), and host-side bookkeeping derives
+    every mode's cell from the traces with the exact loop semantics of
+    the eager path — same fold_in key streams (100/200/300 + t), same
+    onset/stop window, same duty-cycle self-scheduling.  Integer columns
+    match the eager cells exactly; float columns to numerical tolerance
+    (``tests/test_serve.py`` pins both).
+    """
+    import numpy as np
+
+    spec = attack_lib.resolve(attack_name)
+    meta = spec.meta()
+    out: Dict[str, Dict[str, object]] = {}
+    run_modes = []
+    for mode in modes:
+        if mode == "off" and meta["defense_aware"]:
+            out[mode] = _skip(
+                "defense-aware attack observes the published detector "
+                "state; --defense off publishes none (fed/config.py "
+                "rejects the combination for real runs too)"
+            )
+        elif meta["data_level"] and spec.grad_scale == 1.0:
+            out[mode] = _skip(
+                "data-level attack leaves the transmitted stack untouched "
+                "(no stack-level signature exists; see fault/attack tiers "
+                "in DESIGN.md)"
+            )
+        else:
+            run_modes.append(mode)
+    if not run_modes:
+        return out
+    det = det or defense_lib.DetectorParams()
+    pol = pol or defense_lib.PolicyParams(
+        up_n=3, down_m=8, n_rungs=len(ladder), min_flagged=2
+    )
+    if attack_name.split("@")[0] == "duty_cycle":
+        on_p, period = attack_lib.duty_cycle_schedule(pol)
+        onset, stop = 0, None
+        iters = max(iters, 2 * period + on_p)
+    branches = defense_lib.make_branch_table(
+        ladder, honest_size=HONEST, impl="xla", maxiter=50, tol=1e-5,
+        clip_iters=3,
+    )
+    n_rungs = len(ladder)
+    key0 = jax.random.PRNGKey(seed)
+    _, base = honest_stack(key0)
+
+    def step(carry, t):
+        d_state, p_state = carry
+        kt = jax.random.fold_in(key0, 100 + t)
+        w = base[None, :] + 1e-3 * jax.random.normal(kt, (K, D))
+        w = w.astype(jnp.float32)
+        if stop is None:
+            active = t >= onset
+        else:
+            active = jnp.logical_and(t >= onset, t < stop)
+        d_view = None
+        if meta["defense_aware"]:
+            # trainer semantics: the attack observes the PREVIOUS
+            # iteration's published state (it runs pre-update)
+            d_view = attack_lib.DefenseView(
+                step=d_state[0], ema=d_state[1], dev=d_state[2],
+                cusum=d_state[3], rung=p_state[0],
+                detector=det, policy=pol, guess=base,
+            )
+        w_att = spec.apply_message(
+            w, B, jax.random.fold_in(key0, 200 + t), defense=d_view
+        )
+        if spec.grad_scale != 1.0:
+            # traced form of _attacked's untouched-stack check: when the
+            # message attack was a no-op at this t, substitute the
+            # gradient-scale emulation (elementwise select on one traced
+            # predicate — identical values to the eager Python branch)
+            emul = w_att.at[-B:].set(
+                base[None, :] + spec.grad_scale * (w_att[-B:] - base[None, :])
+            )
+            w_att = jnp.where(jnp.all(w_att == w), emul, w_att)
+        w = jnp.where(active, w_att, w)
+        score, finite = defense_lib.client_scores(w, base)
+        d_state, flags = defense_lib.detector_update(
+            d_state, score, finite, det
+        )
+        p_state, susp = defense_lib.policy_update(
+            p_state, jnp.sum(flags), pol
+        )
+        rung = p_state[0]
+        kagg = jax.random.fold_in(key0, 300 + t)
+        agg0 = branches[0]((w, base, kagg))
+        agg_a = jax.lax.switch(
+            jnp.clip(rung, 0, n_rungs - 1), branches, (w, base, kagg)
+        )
+        hm = jnp.mean(w[:HONEST], axis=0)
+        outs = (
+            flags.astype(jnp.int32),
+            jnp.asarray(rung, jnp.int32),
+            jnp.asarray(susp, jnp.int32),
+            jnp.linalg.norm(agg0 - base),
+            jnp.linalg.norm(agg_a - base),
+            jnp.linalg.norm(agg0 - hm),
+            jnp.linalg.norm(agg_a - hm),
+        )
+        return (d_state, p_state), outs
+
+    @jax.jit
+    def kernel():
+        init = (defense_lib.init_detector(K), defense_lib.init_policy())
+        _, traj = jax.lax.scan(step, init, jnp.arange(iters))
+        return traj
+
+    flags_t, rung_t, susp_t, err0_t, erra_t, dist0_t, dista_t = (
+        np.asarray(x) for x in kernel()
+    )
+    active_np = np.array(
+        [onset <= t and (stop is None or t < stop) for t in range(iters)]
+    )
+    last_active = (
+        int(np.max(np.nonzero(active_np)[0])) if active_np.any() else None
+    )
+
+    # detection bookkeeping (mode-independent — one pass serves both
+    # monitor and adaptive, exactly the eager loop's confusion ledger)
+    detect_iter = time_to_detect = None
+    tp = fp = 0
+    detected_rows: set = set()
+    for t in range(iters):
+        byz_hits = [
+            K - B + i for i in range(B) if flags_t[t, K - B + i]
+        ]
+        fp += int(flags_t[t, :HONEST].sum())
+        if active_np[t]:
+            if detect_iter is None and int(flags_t[t].sum()) > 0:
+                detect_iter = t - onset
+            tp += len(byz_hits)
+            detected_rows.update(byz_hits)
+            if byz_hits and time_to_detect is None:
+                time_to_detect = t - onset
+        else:
+            fp += len(byz_hits)
+    n_flags = tp + fp
+
+    def rung_columns(rt):
+        max_rung = transitions = prev = rung_at_stop = 0
+        max_seen_at = min_post = None
+        for t in range(iters):
+            r = int(rt[t])
+            if r > max_rung:
+                max_rung, max_seen_at = r, t
+            transitions += int(r != prev)
+            prev = r
+            if stop is not None and t == stop - 1:
+                rung_at_stop = r
+            if max_seen_at is not None and t > max_seen_at:
+                min_post = r if min_post is None else min(min_post, r)
+        return max_rung, transitions, rung_at_stop, min_post
+
+    for mode in run_modes:
+        rt = np.zeros(iters, dtype=np.int32) if mode == "off" else rung_t
+        max_rung, transitions, rung_at_stop, min_post = rung_columns(rt)
+        err_t, dist_t = (
+            (erra_t, dista_t) if mode == "adaptive" else (err0_t, dist0_t)
+        )
+        final_rung = int(rt[-1])
+        cell: Dict[str, object] = {
+            "detect_iter": None if mode == "off" else detect_iter,
+            "precision": (
+                round(tp / n_flags, 5)
+                if (mode != "off" and n_flags) else None
+            ),
+            "recall": (
+                round(len(detected_rows) / B, 5) if mode != "off" else None
+            ),
+            "time_to_detect": None if mode == "off" else time_to_detect,
+            "rounds_suspicious": (
+                0 if mode == "off" else int((susp_t != 0).sum())
+            ),
+            "max_rung": max_rung,
+            "min_rung_post": min_post,
+            "final_rung": final_rung,
+            "transitions": transitions,
+            "deescalated": stop is not None and final_rung < rung_at_stop,
+            "final_dist": round(float(dist_t[-1]), 5),
+        }
+        if last_active is not None:
+            cell["agg_err"] = round(float(err_t[last_active]), 5)
+        out[mode] = cell
+    return out
+
+
 def run_matrix(
     attacks: List[str],
     modes: List[str],
     ladders: Optional[List[Tuple[str, ...]]] = None,
     log=lambda s: print(s, file=sys.stderr, flush=True),
     on_cell=None,
+    batched: bool = False,
     **sim_kw,
 ) -> Dict[Cell, Dict[str, object]]:
     for a in attacks:
@@ -291,6 +502,22 @@ def run_matrix(
     grid: Dict[Cell, Dict[str, object]] = {}
     for lad in ladders:
         lad_name = ",".join(lad)
+        if batched:
+            # one lowering per (attack, ladder); all modes from its traces
+            for attack in attacks:
+                cells = simulate_cells_batched(
+                    attack, modes, ladder=lad, **sim_kw
+                )
+                for mode in modes:
+                    cell = cells[mode]
+                    grid[(attack, mode, lad_name)] = cell
+                    log(
+                        f"[adaptive_matrix] attack={attack} mode={mode} "
+                        f"ladder={lad_name}: {cell}"
+                    )
+                    if on_cell is not None:
+                        on_cell(attack, mode, lad_name, cell)
+            continue
         for mode in modes:
             for attack in attacks:
                 cell = simulate_cell(attack, mode, ladder=lad, **sim_kw)
@@ -413,7 +640,22 @@ def main(argv=None) -> None:
     ap.add_argument("--assert-smoke", action="store_true",
                     help="exit nonzero unless a defense-aware cell "
                          "detects and duty_cycle stays escalated")
+    ap.add_argument("--batched", action="store_true",
+                    help="run each (attack, ladder) family as ONE jitted "
+                         "scan serving every mode (simulate_cells_batched) "
+                         "instead of the eager per-cell loop")
+    ap.add_argument("--expect-speedup", type=float, default=None,
+                    help="with --batched: also time the eager path and "
+                         "exit nonzero unless batched is at least this "
+                         "many times faster (the CI >=5x bar); records "
+                         "the ratio under _wallclock in the --json dump")
+    ap.add_argument("--perf-row", default=None, metavar="PATH",
+                    help="with --batched: write a matrix_wallclock perf "
+                         "row here (value = eager/batched wall-clock "
+                         "ratio; feed to perf_gate --append)")
     args = ap.parse_args(argv)
+    if (args.expect_speedup is not None or args.perf_row) and not args.batched:
+        ap.error("--expect-speedup/--perf-row require --batched")
 
     attacks = (
         [a for a in args.attacks.split(",") if a]
@@ -444,25 +686,67 @@ def main(argv=None) -> None:
         up_n=3, down_m=8, n_rungs=n_rungs.pop(), min_flagged=2,
         budget_leak=args.leak, floor_thresh=args.floor,
     )
+    sim_kw = dict(
+        iters=args.iters,
+        onset=args.onset,
+        stop=None if args.stop < 0 else args.stop,
+        pol=pol,
+        seed=args.seed,
+    )
+    t0 = time.perf_counter()
     try:
         grid = run_matrix(
             attacks,
             modes,
             ladders=ladders,
-            iters=args.iters,
-            onset=args.onset,
-            stop=None if args.stop < 0 else args.stop,
-            pol=pol,
-            seed=args.seed,
+            batched=args.batched,
             on_cell=lambda attack, mode, lad, cell: sink.emit(
                 obs_lib.make_event(
                     "adaptive_cell", attack=attack, mode=mode,
                     ladder=lad, **cell
                 )
             ),
+            **sim_kw,
         )
     finally:
         sink.close()
+    primary_secs = time.perf_counter() - t0
+    wallclock = None
+    if args.expect_speedup is not None or args.perf_row:
+        # reference timing: the eager path over the same grid (events
+        # and logs suppressed — the batched pass above already emitted)
+        t0 = time.perf_counter()
+        eager_grid = run_matrix(
+            attacks, modes, ladders=ladders, batched=False,
+            log=lambda s: None, **sim_kw,
+        )
+        eager_secs = time.perf_counter() - t0
+        speedup = eager_secs / max(primary_secs, 1e-9)
+        wallclock = {
+            "batched_secs": round(primary_secs, 3),
+            "eager_secs": round(eager_secs, 3),
+            "speedup": round(speedup, 3),
+        }
+        print(
+            f"[adaptive_matrix] wall-clock: eager {eager_secs:.2f}s / "
+            f"batched {primary_secs:.2f}s = {speedup:.2f}x",
+            file=sys.stderr, flush=True,
+        )
+        drift = [
+            (k, col)
+            for k, cell in grid.items()
+            for col in ("detect_iter", "time_to_detect",
+                        "rounds_suspicious", "max_rung", "min_rung_post",
+                        "final_rung", "transitions", "deescalated",
+                        "recall")
+            if cell.get(col) != eager_grid[k].get(col)
+        ]
+        if drift:
+            print(
+                f"[adaptive_matrix] WARNING: batched/eager drift on "
+                f"{len(drift)} integer column(s): {drift[:5]}",
+                file=sys.stderr,
+            )
     print(markdown_table(grid), file=sys.stderr, flush=True)
     if args.out:
         io_lib.atomic_pickle(
@@ -470,13 +754,33 @@ def main(argv=None) -> None:
         )
         print(f"[adaptive_matrix] grid pickled to {args.out}", file=sys.stderr)
     if args.json:
+        dump = {"|".join(k): c for k, c in grid.items()}
+        if wallclock is not None:
+            # the only non-deterministic key; absent in the default
+            # invocation so committed dumps still diff byte-for-byte
+            dump["_wallclock"] = wallclock
         with open(args.json, "w") as f:
-            json.dump(
-                {"|".join(k): c for k, c in grid.items()},
-                f, sort_keys=True, indent=1,
-            )
+            json.dump(dump, f, sort_keys=True, indent=1)
             f.write("\n")
         print(f"[adaptive_matrix] grid dumped to {args.json}", file=sys.stderr)
+    if args.perf_row:
+        row = {
+            "metric": "matrix_wallclock",
+            "value": wallclock["speedup"],
+            "unit": "x",
+            "platform": jax.default_backend(),
+            "note": "eager/batched wall-clock ratio (adaptive_matrix)",
+        }
+        with open(args.perf_row, "w") as f:
+            json.dump(row, f, indent=1)
+            f.write("\n")
+        print(f"[adaptive_matrix] perf row written to {args.perf_row}",
+              file=sys.stderr)
+    if args.expect_speedup is not None and wallclock["speedup"] < args.expect_speedup:
+        raise SystemExit(
+            f"[adaptive_matrix] batched speedup {wallclock['speedup']}x "
+            f"below the --expect-speedup {args.expect_speedup}x bar"
+        )
     if args.assert_smoke:
         assert_smoke(grid)
 

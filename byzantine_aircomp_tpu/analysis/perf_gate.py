@@ -21,8 +21,9 @@ through.  This gate closes the loop:
   ``--strict-platform``; ``--expect-platform tpu`` forces the verdict
   when the row's platform differs — the exact BENCH_r05 fallback trap.
 
-``--append`` records the gated row into the ledger after a non-regression
-verdict (so green runs extend the baseline); ``--self-check`` runs the
+``--append`` records the gated row into the ledger after an ``ok`` /
+``improvement`` / ``new_metric`` verdict (green runs extend the baseline;
+``platform_mismatch`` rows never seed it); ``--self-check`` runs the
 synthetic acceptance scenarios (2x slowdown must fail, ±10% noise must
 pass, cross-platform must refuse) against a throwaway ledger and needs
 no inputs — CI runs it before trusting the gate.
@@ -200,7 +201,8 @@ def main(argv=None) -> int:
     ap.add_argument("--rel-tol", type=float, default=DEFAULT_REL_TOL)
     ap.add_argument("--mad-sigmas", type=float, default=DEFAULT_MAD_SIGMAS)
     ap.add_argument("--append", action="store_true",
-                    help="append the row to the ledger unless it regressed")
+                    help="append the row to the ledger on an ok/"
+                    "improvement/new_metric verdict")
     ap.add_argument("--json", action="store_true",
                     help="emit the verdict as JSON on stdout")
     ap.add_argument("--self-check", action="store_true",
@@ -234,7 +236,11 @@ def main(argv=None) -> int:
         mad_sigmas=args.mad_sigmas,
     )
     code = _exit_code(verdict["verdict"], args.strict_platform)
-    if args.append and verdict["verdict"] != "regression":
+    # append only verdicts that extend a trustworthy baseline: a
+    # platform_mismatch row would seed the ledger with exactly the
+    # cross-platform history --expect-platform exists to keep out
+    if args.append and verdict["verdict"] in ("ok", "improvement",
+                                              "new_metric"):
         # descriptive columns (the stream_ksweep peak-bytes fields) ride
         # along so a gated append is as self-describing as a direct one
         extra = {

@@ -29,25 +29,18 @@ from ..registry import AGGREGATORS, ATTACKS
 from ..utils import io as io_lib
 
 
-def run_cell(
-    agg: str, attack: Optional[str], cfg_kw: dict, dataset
-) -> Dict[str, float]:
-    """Train one (aggregator, attack) cell; returns its final metrics.
-
-    ``rounds_per_sec`` excludes compile and eval: round 0 is the warmup
-    (it triggers tracing) and the timer stops before ``evaluate`` — with
-    ``rounds=1`` there is nothing post-compile to time, so the field is
-    omitted."""
-    import jax.numpy as jnp
-
+def _cell_kw(
+    agg: str, attack: Optional[str], cfg_kw: dict
+) -> Tuple[dict, Dict[str, object]]:
+    """Per-cell knob sanitization, so one global knob set can cover a mixed
+    matrix: attack_param only reaches attacks that take one, and krum_m
+    is clamped when the byz-zeroed 'none' cell shrinks node_size below
+    it.  Every adjustment is recorded in ``effective`` so the emitted
+    rows / pickled grid can't misrepresent which knobs a cell actually
+    ran.  Shared by the solo and batched cell paths."""
     kw = dict(cfg_kw)
     kw["agg"] = agg
     kw["attack"] = attack
-    # per-cell knob sanitization, so one global knob set can cover a mixed
-    # matrix: attack_param only reaches attacks that take one, and krum_m
-    # is clamped when the byz-zeroed 'none' cell shrinks node_size below
-    # it.  Every adjustment is recorded in ``effective`` so the emitted
-    # rows / pickled grid can't misrepresent which knobs a cell actually ran
     effective: Dict[str, object] = {}
     if attack is None and kw.get("byz_size"):
         kw["byz_size"] = 0  # reference semantics (run(), :430-431)
@@ -62,6 +55,21 @@ def run_cell(
         if clamped != kw["krum_m"]:
             effective["krum_m"] = clamped
         kw["krum_m"] = clamped
+    return kw, effective
+
+
+def run_cell(
+    agg: str, attack: Optional[str], cfg_kw: dict, dataset
+) -> Dict[str, float]:
+    """Train one (aggregator, attack) cell; returns its final metrics.
+
+    ``rounds_per_sec`` excludes compile and eval: round 0 is the warmup
+    (it triggers tracing) and the timer stops before ``evaluate`` — with
+    ``rounds=1`` there is nothing post-compile to time, so the field is
+    omitted."""
+    import jax.numpy as jnp
+
+    kw, effective = _cell_kw(agg, attack, cfg_kw)
     cfg = FedConfig(**kw)
     trainer = FedTrainer(cfg, dataset=dataset)
     # the single-round program is shape-independent, so round 0 both warms
@@ -84,6 +92,49 @@ def run_cell(
     return metrics
 
 
+def run_cell_batched(
+    agg: str, attack: Optional[str], cfg_kw: dict, dataset, seeds: int
+) -> List[Dict[str, float]]:
+    """Every seed replica of one cell as lanes of ONE
+    :class:`serve.batch.BatchRunner` — one lowering for the whole seed
+    axis instead of one trainer (and one compile) per seed.
+
+    Seed is structurally batchable (each lane carries its own base key
+    and init params), so the per-lane trajectories are bit-identical to
+    the solo path.  ``rounds_per_sec`` here is the BATCH throughput
+    (rounds/sec of the N-lane program, same value on every replica) —
+    the number that tells you what the batching bought, not a per-lane
+    share."""
+    import jax
+
+    from ..serve.batch import BatchRunner
+
+    kw, effective = _cell_kw(agg, attack, cfg_kw)
+    base_seed = kw.get("seed", 2021)
+    cfgs = [FedConfig(**dict(kw, seed=base_seed + s)) for s in range(seeds)]
+    batch = BatchRunner(cfgs, dataset=dataset)
+    batch.run_round(0)  # warmup: the one compile
+    jax.block_until_ready(batch.carry[0])
+    rps = None
+    if cfgs[0].rounds > 1:
+        t0 = time.perf_counter()
+        for r in range(1, cfgs[0].rounds):
+            batch.run_round(r)
+        jax.block_until_ready(batch.carry[0])
+        rps = round((cfgs[0].rounds - 1) / (time.perf_counter() - t0), 3)
+    runs = []
+    for lane in range(seeds):
+        loss, acc = batch.evaluate(lane, "val")
+        metrics: Dict[str, float] = {}
+        if rps is not None:
+            metrics["rounds_per_sec"] = rps
+        metrics.update(val_acc=round(acc, 4), val_loss=round(loss, 4))
+        if effective:
+            metrics["effective"] = effective
+        runs.append(metrics)
+    return runs
+
+
 def run_sweep(
     aggs: List[str],
     attacks: List[Optional[str]],
@@ -92,12 +143,17 @@ def run_sweep(
     log=lambda s: print(s, file=sys.stderr, flush=True),
     on_cell=None,
     seeds: int = 1,
+    batched: bool = False,
 ) -> Dict[Tuple[str, Optional[str]], Dict[str, float]]:
     """The full matrix; dataset is loaded once and shared across cells.
     ``on_cell(agg, attack, metrics)`` fires as each cell completes, so
     callers can stream results and a late-cell crash loses nothing.
     ``seeds > 1`` repeats each cell at consecutive seeds and reports the
-    mean, plus ``val_acc_std`` across seeds."""
+    mean, plus ``val_acc_std`` across seeds.  ``batched=True`` runs the
+    seed axis of each cell through one vmapped
+    :class:`serve.batch.BatchRunner` lowering
+    (:func:`run_cell_batched`); the eager per-seed loop stays the
+    default."""
     from ..data import datasets as data_lib
 
     if seeds < 1:
@@ -113,10 +169,16 @@ def run_sweep(
     grid: Dict[Tuple[str, Optional[str]], Dict[str, float]] = {}
     for attack in attacks:
         for agg in aggs:
-            runs = []
-            for s in range(seeds):
-                kw = dict(cfg_kw, seed=base_seed + s)
-                runs.append(run_cell(agg, attack, kw, dataset))
+            if batched:
+                runs = run_cell_batched(
+                    agg, attack, dict(cfg_kw, seed=base_seed), dataset,
+                    seeds,
+                )
+            else:
+                runs = []
+                for s in range(seeds):
+                    kw = dict(cfg_kw, seed=base_seed + s)
+                    runs.append(run_cell(agg, attack, kw, dataset))
             cell = {
                 k: round(sum(r[k] for r in runs) / len(runs), 4)
                 for k in runs[0]
@@ -168,6 +230,11 @@ def main(argv=None) -> None:
     ap.add_argument("--seeds", type=int, default=1,
                     help="repeat each cell at N consecutive seeds; reports "
                          "the mean (+ val_acc_std)")
+    ap.add_argument("--batched", action="store_true",
+                    help="run each cell's seed axis as lanes of one "
+                         "vmapped serve.batch.BatchRunner lowering "
+                         "(bit-identical to the per-seed loop; see "
+                         "docs/SERVING.md)")
     add_knob_flags(ap)  # shared with the main CLI (incl. help text)
     ap.add_argument("--out", default=None, help="pickle the grid here")
     ap.add_argument("--obs-dir", default=None,
@@ -248,6 +315,7 @@ def main(argv=None) -> None:
             attacks,
             cfg_kw,
             seeds=args.seeds,
+            batched=args.batched,
             on_cell=lambda agg, attack, cell: sink.emit(
                 obs_lib.make_event(
                     "sweep_cell", agg=agg, attack=attack or "none", **cell

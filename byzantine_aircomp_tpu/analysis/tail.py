@@ -269,7 +269,17 @@ def main(argv=None) -> int:
                     help="poll interval in seconds while following")
     ap.add_argument("--max-seconds", type=float, default=None,
                     help="stop following after this long (smoke tests)")
+    ap.add_argument("--run", type=str, default=None, metavar="RUN_ID",
+                    help="tail one tenant of an experiment-server obs "
+                         "root: narrows target to <target>/<run_id>/ "
+                         "(the run's private subtree; docs/SERVING.md)")
     args = ap.parse_args(argv)
+    if args.run is not None:
+        if not os.path.isdir(args.target):
+            print(f"--run needs a server obs-root directory, got "
+                  f"{args.target}", file=sys.stderr)
+            return 1
+        args.target = os.path.join(args.target, args.run)
     renderer = Renderer()
     if args.once:
         stream = discover_stream(args.target)

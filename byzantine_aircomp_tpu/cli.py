@@ -10,6 +10,10 @@ reference's dead flag (``:22,:500``).  New flags: ``--backend {jax,ref}``
 configs; flags present on the command line override the preset), ``--dataset``,
 ``--model``, ``--rounds``, ``--interval``, ``--batch-size``, ``--gamma``,
 ``--seed``, and the execution-layout/observability flags.
+
+One subcommand lives outside the flag surface: ``serve`` boots the
+multi-tenant experiment server (``serve/``; docs/SERVING.md) instead of
+running a single training job.
 """
 
 from __future__ import annotations
@@ -559,11 +563,55 @@ def config_from_args(args, argv: Optional[Sequence[str]] = None) -> FedConfig:
     return cfg
 
 
+def serve_main(argv: Sequence[str]):
+    """``python -m byzantine_aircomp_tpu serve``: boot the multi-tenant
+    experiment server (docs/SERVING.md) and block until interrupted."""
+    import time
+
+    p = argparse.ArgumentParser("byzantine_aircomp_tpu serve")
+    p.add_argument("--port", type=int, default=0,
+                   help="HTTP port for the run API + /metrics + /healthz "
+                        "(0 = OS-assigned ephemeral, printed at boot)")
+    p.add_argument("--host", type=str, default="0.0.0.0")
+    p.add_argument("--obs-root", type=str, default="./serve_runs",
+                   help="root of the per-run output subtrees "
+                        "(<obs-root>/<run_id>/ holds each tenant's events, "
+                        "checkpoints, caches)")
+    p.add_argument("--backend", choices=["vmap", "map"], default="vmap",
+                   help="experiment-axis batching backend (map = "
+                        "sequential lax.map escape hatch)")
+    p.add_argument("--batch-window", type=float, default=0.25,
+                   help="seconds to wait after a submission before "
+                        "compiling, so concurrent tenants coalesce into "
+                        "one batch (one XLA lowering)")
+    args = p.parse_args(list(argv))
+    from .serve.server import ExperimentServer
+
+    server = ExperimentServer(
+        args.obs_root,
+        port=args.port,
+        host=args.host,
+        backend=args.backend,
+        batch_window=args.batch_window,
+    ).start()
+    print(f"experiment server on {args.host}:{server.port} "
+          f"(obs root: {args.obs_root})", flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+
+
 def main(argv: Optional[Sequence[str]] = None):
     import sys
 
     if argv is None:
         argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
     if (
         args.multihost

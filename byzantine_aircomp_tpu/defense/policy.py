@@ -86,11 +86,24 @@ def policy_update(pol: PolicyState, n_flagged, p: PolicyParams):
     # above the threshold the floor keeps one rung of caution in place
     # however long the attacker sleeps
     budget = budget * (1.0 - p.budget_leak) + escalate.astype(jnp.float32)
-    if p.floor_thresh > 0:
-        floor = (budget >= p.floor_thresh).astype(jnp.int32)
-        floor = jnp.minimum(floor, p.n_rungs - 1)
+    if isinstance(p.floor_thresh, (int, float)):
+        if p.floor_thresh > 0:
+            floor = (budget >= p.floor_thresh).astype(jnp.int32)
+            floor = jnp.minimum(floor, p.n_rungs - 1)
+        else:
+            floor = jnp.int32(0)
     else:
-        floor = jnp.int32(0)
+        # traced floor_thresh (the experiment-axis batch runner feeds a
+        # per-experiment knob): branchless equivalent of the static paths,
+        # so a batch may mix enabled and disabled floors in one lowering
+        floor = jnp.minimum(
+            jnp.where(
+                p.floor_thresh > 0,
+                (budget >= p.floor_thresh).astype(jnp.int32),
+                0,
+            ),
+            p.n_rungs - 1,
+        )
     rung = jnp.clip(
         rung + escalate.astype(jnp.int32) - deescalate.astype(jnp.int32),
         floor,

@@ -935,3 +935,32 @@ def coerce_field(name: str, raw: str):
     if tp is bool:
         return raw.lower() in ("1", "true", "yes")
     return tp(raw)
+
+
+def config_from_mapping(body: dict) -> FedConfig:
+    """Build a validated FedConfig from a JSON-ish mapping (the experiment
+    server's ``POST /runs`` body).  Strings go through :func:`coerce_field`
+    (same rules as ``--set``); JSON numbers are cast by the field
+    annotation so ``{"gamma": 1}`` stores a float like the CLI would;
+    bools/None pass through.  Raises ``ValueError`` naming the first
+    unknown field — a typo'd knob must be a 400, not a silent default.
+    """
+    hints = typing.get_type_hints(FedConfig)
+    kwargs = {}
+    for name, value in body.items():
+        if name not in hints:
+            raise ValueError(f"unknown FedConfig field {name!r}")
+        if isinstance(value, str):
+            kwargs[name] = coerce_field(name, value)
+        elif isinstance(value, bool) or value is None:
+            kwargs[name] = value
+        elif isinstance(value, (int, float)):
+            tp = hints[name]
+            if typing.get_origin(tp) is typing.Union:  # Optional[...]
+                tp = [a for a in typing.get_args(tp) if a is not type(None)][0]
+            kwargs[name] = tp(value) if tp in (int, float) else value
+        else:
+            kwargs[name] = value
+    cfg = FedConfig(**kwargs)
+    cfg.validate()
+    return cfg

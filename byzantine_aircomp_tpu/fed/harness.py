@@ -247,6 +247,28 @@ def ckpt_title(cfg: FedConfig) -> str:
     return f"{run_title(cfg)}_c{config_hash(cfg)}"
 
 
+def run_namespace(cfg: FedConfig, run_id: str, root: str) -> FedConfig:
+    """Rebase every output-only path onto the run's private subtree
+    ``<root>/<run_id>/`` — the tenancy boundary of the experiment server.
+
+    Events, checkpoints, caches, and profiles from different runs can
+    never collide or interleave because each run writes only under its
+    own ``run_id``.  Nothing here touches the trajectory: every
+    rewritten field is in :func:`config_hash`'s unconditional skip list,
+    so the namespaced config keeps the submitted config's identity.
+    """
+    ns = os.path.join(root, run_id)
+    os.makedirs(ns, exist_ok=True)
+    return dataclasses.replace(
+        cfg,
+        obs_dir=ns,
+        checkpoint_dir=os.path.join(ns, "ckpt"),
+        cache_dir=os.path.join(ns, "cache"),
+        profile_dir=os.path.join(ns, "profile"),
+        log_file="",
+    )
+
+
 def cache_path(cfg: FedConfig, dataset_name: str) -> str:
     cache_dir = cfg.cache_dir or f"./{dataset_name.upper()}_Air_weight_tpu/"
     os.makedirs(cache_dir, exist_ok=True)

@@ -42,7 +42,11 @@ from .alerts import AlertEngine, load_rules  # noqa: F401
 from .exporter import MetricsExporter  # noqa: F401
 from .forensics import FlightRecorder, emit_round_flags  # noqa: F401
 from .ledger import PerfLedger, config_key, robust_stats  # noqa: F401
-from .metrics import MetricsRegistry, MetricsSink  # noqa: F401
+from .metrics import (  # noqa: F401
+    LabeledRegistry,
+    MetricsRegistry,
+    MetricsSink,
+)
 from .profile import (  # noqa: F401
     NULL_PROFILER,
     Profiler,

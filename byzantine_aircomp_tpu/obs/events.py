@@ -43,7 +43,11 @@ from typing import Any, Dict, Optional
 # — tests/test_schema.py pins a golden fingerprint per version and fails
 # CI on silent drift (``python tests/test_schema.py --regen`` prints the
 # new golden row and the doc table stubs a bump requires).
-SCHEMA_VERSION = 3
+# v4: added the multi-tenant serving kinds ``run_submitted`` /
+# ``run_cancelled`` / ``knob_swap`` (serve/runs.py control-plane audit
+# trail — every tenant-visible state change lands in the run's own
+# event stream).
+SCHEMA_VERSION = 4
 
 # round-event field -> reference pickled-record key it mirrors
 # (round r's event carries metrics the record stores at index r+1 for the
@@ -103,6 +107,13 @@ _REQUIRED: Dict[str, tuple] = {
     # re-emitted every round) and the end-of-run metrics-registry dump
     "alert": ("round", "rule", "severity", "value", "firing"),
     "metrics_snapshot": ("round", "metrics"),
+    # multi-tenant serving (serve/runs.py): control-plane audit events in
+    # the run's own stream — submission (with the batch-group signature),
+    # cancellation (at which round the lane went dark), and each accepted
+    # between-rounds knob hot-swap
+    "run_submitted": ("run_id", "title", "signature"),
+    "run_cancelled": ("run_id", "round"),
+    "knob_swap": ("run_id", "round", "knob", "value"),
 }
 
 

@@ -13,6 +13,13 @@ closes it in the same ``finally`` that closes the sinks — run end AND
 crash both shut the port down cleanly.  ``port=0`` binds an OS-assigned
 ephemeral port (tests); the bound port is on ``.port`` after
 ``start()``.
+
+``routes`` lets a caller mount extra endpoints on the same port without
+subclassing the handler: a callable ``(method, path, body) ->
+Optional[(status, content_type, body_bytes)]`` tried before the built-in
+``/metrics``/``/healthz`` handling (``None`` falls through).  The
+experiment server (``serve/server.py``) rides this hook so one socket
+serves both the control plane and the scrape surface.
 """
 
 from __future__ import annotations
@@ -26,6 +33,10 @@ from .metrics import MetricsRegistry
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+#: extra-route hook: (method, path, body) -> (status, content_type, body)
+#: or None to fall through to the built-in routes
+RouteFn = Callable[[str, str, bytes], Optional[tuple]]
+
 
 class MetricsExporter:
     """Background /metrics + /healthz server over one registry."""
@@ -36,11 +47,13 @@ class MetricsExporter:
         port: int = 0,
         host: str = "0.0.0.0",
         health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        routes: Optional[RouteFn] = None,
     ) -> None:
         self.registry = registry
         self._requested_port = port
         self._host = host
         self._health_fn = health_fn
+        self._routes = routes
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -57,8 +70,36 @@ class MetricsExporter:
             def log_message(self, *args) -> None:  # silence request spam
                 pass
 
+            def _reply(self, status, ctype, body) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _try_routes(self, method: str) -> bool:
+                if exporter._routes is None:
+                    return False
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                hit = exporter._routes(
+                    method, self.path.split("?", 1)[0], body
+                )
+                if hit is None:
+                    return False
+                self._reply(*hit)
+                return True
+
+            def do_POST(self) -> None:
+                if not self._try_routes("POST"):
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+
             def do_GET(self) -> None:
-                if self.path.split("?", 1)[0] == "/metrics":
+                if self._try_routes("GET"):
+                    pass
+                elif self.path.split("?", 1)[0] == "/metrics":
                     body = exporter.registry.render().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", CONTENT_TYPE)

@@ -192,6 +192,49 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+class LabeledRegistry:
+    """A write-through view of a :class:`MetricsRegistry` that stamps a
+    fixed label set (e.g. ``run_id``) onto every series it touches.
+
+    The multi-tenant control plane gives each run a
+    ``MetricsSink(LabeledRegistry(shared, run_id=...))`` so one scrape
+    endpoint exposes every tenant's counters side by side —
+    ``aircomp_events_total{kind="round",run_id="r42"}`` — without the
+    per-kind fold methods knowing anything about tenancy.  Explicit
+    labels win over the fixed ones on collision (none of the built-in
+    folds uses ``run_id``, so in practice they merge).  Reads
+    (``render``/``snapshot``) go straight to the base registry; ``value``
+    merges the fixed labels so per-run alert engines query their own
+    series."""
+
+    def __init__(self, base: MetricsRegistry, **labels: str) -> None:
+        self.base = base
+        self.labels = {k: str(v) for k, v in labels.items()}
+
+    def inc(self, name: str, amount: float = 1.0, help_text: str = "",
+            **labels: str) -> None:
+        self.base.inc(name, amount, help_text, **{**self.labels, **labels})
+
+    def set(self, name: str, value: float, help_text: str = "",
+            **labels: str) -> None:
+        self.base.set(name, value, help_text, **{**self.labels, **labels})
+
+    def observe(self, name: str, value: float,
+                buckets: Tuple[float, ...] = ROUND_SECONDS_BUCKETS,
+                help_text: str = "", **labels: str) -> None:
+        self.base.observe(name, value, buckets, help_text,
+                          **{**self.labels, **labels})
+
+    def value(self, name: str, **labels: str) -> Optional[float]:
+        return self.base.value(name, **{**self.labels, **labels})
+
+    def snapshot(self) -> Dict[str, Any]:
+        return self.base.snapshot()
+
+    def render(self) -> str:
+        return self.base.render()
+
+
 def _fmt(v: float) -> str:
     if isinstance(v, float) and math.isinf(v):
         return "+Inf" if v > 0 else "-Inf"

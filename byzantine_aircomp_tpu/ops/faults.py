@@ -288,7 +288,15 @@ def apply_deadline(
     absent = drawn-but-offline, late = arrived but past deadline.
     """
     k = w_stack.shape[0]
-    if straggler_prob > 0.0:
+    if not isinstance(straggler_prob, (int, float)):
+        # traced probability (the experiment-axis batch runner feeds a
+        # per-experiment knob): always trace the bernoulli — at p == 0.0
+        # it draws uniform < 0.0 == all-False, numerically identical to
+        # the static zero branch below
+        late = jnp.logical_and(
+            arrived, jax.random.bernoulli(key, straggler_prob, (k,))
+        )
+    elif straggler_prob > 0.0:
         late = jnp.logical_and(
             arrived, jax.random.bernoulli(key, straggler_prob, (k,))
         )

@@ -1,0 +1,29 @@
+"""Multi-tenant experiment serving: many runs, one compiled trainer.
+
+Two rungs (ROADMAP item #4):
+
+* :mod:`.batch`  — the experiment-axis vmap runner: N same-shape configs
+  become one jitted round program, with seeds / attack scales / detector
+  constants / channel SNR carried as traced per-experiment data
+  (:class:`~.batch.BatchableKnobs`) instead of hashed statics.  One
+  lowering serves every cell; the seed-only batch is bit-identical to N
+  independent solo runs.
+* :mod:`.runs` + :mod:`.server` — the resident control plane: a stdlib
+  HTTP surface (extending ``obs/exporter.py``) to submit / inspect /
+  cancel runs and hot-swap batchable knobs between rounds, with per-run
+  obs-dir subtrees, ``run_id``-labelled metrics, and checkpoint
+  namespaces so tenants cannot read each other's artifacts.
+
+See docs/SERVING.md for the API and the batchable-knob contract.
+"""
+
+from .batch import (  # noqa: F401
+    BATCHABLE_KNOBS,
+    BatchRunner,
+    applicable_knobs,
+    gather_knobs,
+    static_signature,
+    validate_batch,
+)
+from .runs import RunManager  # noqa: F401
+from .server import ExperimentServer  # noqa: F401

@@ -1,0 +1,557 @@
+"""Experiment-axis batching: N same-shape configs, ONE jitted round fn.
+
+Every study so far costs one process and one XLA lowering per config —
+``sweep.py`` and the analysis matrices fork a fresh interpreter per cell
+and recompile the identical round program dozens of times.  This module
+converts the experiment axis into a *batch* axis: N configs that agree on
+everything structural (shapes, aggregator choice, execution-path
+selection) are stacked into one carry pytree, their divergent scalars
+(seeds via per-experiment base keys; learning rate, attack magnitude,
+channel SNR, detector/ladder constants as a :class:`BatchableKnobs` dict
+of traced ``[N]`` arrays), and ``jax.vmap`` maps the UNMODIFIED
+``FedTrainer._round_core`` over the stack under one ``jax.jit``.  One
+lowering serves all N cells; a knob change is a device-array update, so
+hot-swapping between rounds can never retrace (machine-checked by the
+RetraceDetector gate, name ``batch_round_fn``).
+
+Bit-identity: on the seed-only batch (all knobs equal, seeds differ) the
+vmapped program reproduces each solo run's trajectory bit-for-bit — the
+per-lane computation is the same dot_generals over the same operands, and
+the per-round key derivation (``fold_in(base_key, round)``) is identical
+because each lane carries its own base key.  tests/test_serve.py pins
+this.  A ``backend="map"`` escape hatch lowers through ``jax.lax.map``
+(sequential per-lane execution of the solo-shaped element program) for
+platforms where a vmapped primitive reassociates.
+
+The contract (what must MATCH across the batch) is enforced by
+:func:`validate_batch` and documented in docs/SERVING.md: every
+config field that selects a traced-program *structure* — model/dataset
+shapes, client counts, aggregator and ladder names, attack identity,
+path selection (service/cohort/participation/bucketing/momentum/fedprox),
+server-optimizer wiring — must be equal; output-only observability knobs
+may differ freely; the knobs in :data:`BATCHABLE_KNOBS` become per-lane
+data.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import hashlib
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import obs as obs_lib
+from ..defense import events as defense_events
+from ..fed.config import FedConfig
+from ..obs import forensics as forensics_lib
+
+#: knobs bound onto the (copied) cfg the round fn reads at trace time
+_CFG_KNOBS = (
+    "gamma", "weight_decay", "attack_param", "noise_var",
+    "churn_arrival", "churn_departure", "straggler_prob",
+)
+#: cfg knob -> DetectorParams field
+_DETECTOR_KNOBS = {
+    "defense_alpha": "alpha",
+    "defense_drift": "drift",
+    "defense_z": "z_thresh",
+    "defense_cusum": "cusum_thresh",
+    "defense_warmup": "warmup",
+}
+#: cfg knob -> PolicyParams field
+_POLICY_KNOBS = {
+    "defense_up": "up_n",
+    "defense_down": "down_m",
+    "defense_min_flagged": "min_flagged",
+    "defense_leak": "budget_leak",
+    "defense_floor": "floor_thresh",
+}
+_INT_KNOBS = frozenset(
+    {"defense_warmup", "defense_up", "defense_down", "defense_min_flagged"}
+)
+
+#: every knob that can ride the experiment axis as traced data.  ``seed``
+#: is batchable *structurally*: each lane carries its own base key and
+#: initial params, no tracer needed.
+BATCHABLE_KNOBS = (
+    ("seed",)
+    + _CFG_KNOBS
+    + tuple(_DETECTOR_KNOBS)
+    + tuple(_POLICY_KNOBS)
+)
+
+#: fields that relocate/duplicate outputs without touching the traced
+#: program — free to differ across the batch (mirrors config_hash's
+#: unconditional skip list; forensics is NOT here: the in-jit top-M
+#: extraction is part of the traced program)
+_OUTPUT_ONLY = (
+    "checkpoint_dir", "cache_dir", "profile_dir", "profile_rounds",
+    "inherit", "mark", "obs_dir", "obs_stdout", "log_file", "quiet",
+    "hbm_warn_factor", "metrics", "metrics_port", "alerts",
+    "obs_rotate_mb",
+)
+
+
+def applicable_knobs(cfg: FedConfig) -> List[str]:
+    """The traced-knob subset live for this config family: a knob whose
+    feature is off (no attack parameter, noiseless channel, defense off,
+    single-tenant service off) has no traced read site, so it is neither
+    stacked nor hot-swappable."""
+    knobs = ["gamma", "weight_decay"]
+    if cfg.attack is not None and cfg.attack_param is not None:
+        knobs.append("attack_param")
+    if cfg.noise_var is not None:
+        knobs.append("noise_var")
+    if cfg.service == "on":
+        knobs += ["churn_arrival", "churn_departure", "straggler_prob"]
+    if cfg.defense != "off":
+        knobs += list(_DETECTOR_KNOBS) + list(_POLICY_KNOBS)
+    return knobs
+
+
+def validate_batch(cfgs: Sequence[FedConfig]) -> List[str]:
+    """The batchable-knob contract.  Raises ``ValueError`` naming the
+    first violation; returns the applicable traced-knob names on success.
+
+    Must match across the batch: every FedConfig field that is neither
+    batchable (:data:`BATCHABLE_KNOBS`) nor output-only — shapes,
+    aggregator/ladder/attack identity, path selection, ``rounds``.
+    Presence classes must match where a knob's *existence* gates traced
+    structure: ``attack_param`` / ``noise_var`` are all-None or all-set.
+    Additional structural constraints of the v1 runner: no streamed
+    cohorts (``cohort_size == 0`` — the cohort scan Python-gates on knob
+    values), ``service == "on"`` requires ``rollback == "off"`` (warm
+    rollback restores host state per run and cannot ride a shared batch
+    carry), and a ``dirichlet`` partition requires matching seeds (the
+    data permutation is seed-derived, and lanes share one data layout).
+    """
+    if not cfgs:
+        raise ValueError("validate_batch: empty batch")
+    for cfg in cfgs:
+        cfg.validate()
+    t = cfgs[0]
+    skip = set(BATCHABLE_KNOBS) | set(_OUTPUT_ONLY)
+    for f in dataclasses.fields(FedConfig):
+        if f.name in skip:
+            continue
+        vals = [getattr(c, f.name) for c in cfgs]
+        if any(v != vals[0] for v in vals[1:]):
+            raise ValueError(
+                f"batch contract: field {f.name!r} must match across the "
+                f"batch (it selects traced-program structure), got "
+                f"{sorted(set(map(repr, vals)))}"
+            )
+    for knob in ("attack_param", "noise_var"):
+        classes = {getattr(c, knob) is None for c in cfgs}
+        if len(classes) > 1:
+            raise ValueError(
+                f"batch contract: {knob} presence must match across the "
+                f"batch (None gates a traced branch); mix of set/None"
+            )
+    if t.cohort_size != 0:
+        raise ValueError(
+            "batch contract: cohort streaming (cohort_size > 0) is not "
+            "batchable — the cohort scan selects structure from knob "
+            "values; run streamed configs solo"
+        )
+    if t.service == "on" and t.rollback != "off":
+        raise ValueError(
+            "batch contract: service batches require rollback='off' "
+            "(warm rollback restores per-run host state outside the "
+            "shared batch carry)"
+        )
+    if t.partition == "dirichlet":
+        seeds = {c.seed for c in cfgs}
+        if len(seeds) > 1:
+            raise ValueError(
+                "batch contract: a dirichlet partition derives the data "
+                "permutation from the seed; batched lanes share one data "
+                "layout, so seeds must match (use contiguous for seed "
+                "batches)"
+            )
+    return applicable_knobs(t)
+
+
+def static_signature(cfg: FedConfig) -> str:
+    """Stable digest of everything :func:`validate_batch` requires to
+    match — two configs with equal signatures can share one
+    :class:`BatchRunner` (the RunManager's grouping key)."""
+    skip = set(BATCHABLE_KNOBS) | set(_OUTPUT_ONLY)
+    parts = []
+    for f in sorted(dataclasses.fields(FedConfig), key=lambda f: f.name):
+        if f.name in skip:
+            continue
+        parts.append(f"{f.name}={getattr(cfg, f.name)!r}")
+    parts.append(f"attack_param_set={cfg.attack_param is not None}")
+    parts.append(f"noise_var_set={cfg.noise_var is not None}")
+    if cfg.partition == "dirichlet":
+        parts.append(f"seed={cfg.seed}")
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:12]
+
+
+def gather_knobs(cfgs: Sequence[FedConfig]) -> Dict[str, jnp.ndarray]:
+    """The :class:`BatchableKnobs` pytree: knob name -> ``[N]`` device
+    array over the batch.  EVERY applicable knob is stacked — even one
+    constant across the batch — so a later hot-swap is a pure data
+    update, never a closure-constant change (which would retrace)."""
+    knobs = applicable_knobs(cfgs[0])
+    out = {}
+    for k in knobs:
+        dtype = jnp.int32 if k in _INT_KNOBS else jnp.float32
+        out[k] = jnp.asarray([getattr(c, k) for c in cfgs], dtype=dtype)
+    return out
+
+
+@contextmanager
+def _bound(template, values: Dict[str, Any]):
+    """Install per-experiment knob values (typically tracers) into the
+    template trainer for the duration of one trace.
+
+    ``FedTrainer._round_core`` reads ``self.cfg`` and
+    ``self.defense.detector/policy`` at TRACE time, so swapping a copied
+    cfg (plain dataclass -> ``copy.copy`` + setattr) and a
+    ``dataclasses.replace``d DefenseSpec routes every knob read through
+    the traced values without touching the trainer's real state."""
+    old_cfg, old_defense = template.cfg, template.defense
+    cfg = copy.copy(old_cfg)
+    for knob in _CFG_KNOBS:
+        if knob in values:
+            setattr(cfg, knob, values[knob])
+    defense = old_defense
+    if defense is not None:
+        det_kw = {
+            field: values[knob]
+            for knob, field in _DETECTOR_KNOBS.items()
+            if knob in values
+        }
+        pol_kw = {
+            field: values[knob]
+            for knob, field in _POLICY_KNOBS.items()
+            if knob in values
+        }
+        if det_kw or pol_kw:
+            defense = dataclasses.replace(
+                defense,
+                detector=dataclasses.replace(defense.detector, **det_kw),
+                policy=dataclasses.replace(defense.policy, **pol_kw),
+            )
+    template.cfg, template.defense = cfg, defense
+    try:
+        yield
+    finally:
+        template.cfg, template.defense = old_cfg, old_defense
+
+
+class BatchRunner:
+    """N same-shape experiments through one jitted, vmapped round fn.
+
+    Builds N real ``FedTrainer``s (jit wrappers are lazy, so construction
+    costs init-state only; the dataset is loaded once and shared), stacks
+    their 7-slot carries and base keys, and drives the template trainer's
+    ``_round_core`` under ``jit(vmap(...))`` with the round index as a
+    traced ``int32`` (the ``_build_multi_round_fn`` fold_in discipline) —
+    so rounds, knob swaps, and lane cancellation all reuse ONE lowering.
+    """
+
+    def __init__(
+        self,
+        cfgs: Sequence[FedConfig],
+        dataset=None,
+        retrace: Optional[obs_lib.RetraceDetector] = None,
+        backend: str = "vmap",
+    ) -> None:
+        from ..data import datasets as data_lib
+        from ..fed.train import FedTrainer
+
+        self.knob_names = validate_batch(cfgs)
+        if backend not in ("vmap", "map"):
+            raise ValueError(f"backend must be 'vmap' or 'map', got {backend!r}")
+        self.cfgs = list(cfgs)
+        self.n = len(self.cfgs)
+        dataset = dataset or data_lib.load(self.cfgs[0].dataset)
+        self.trainers = [FedTrainer(c, dataset=dataset) for c in self.cfgs]
+        self.template = self.trainers[0]
+        self.knobs = gather_knobs(self.cfgs)
+        self.carry = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[self._carry_of(t) for t in self.trainers],
+        )
+        self.base_keys = jnp.stack([t._base_key for t in self.trainers])
+        self.retrace = retrace or obs_lib.RetraceDetector()
+        self.active = [True] * self.n
+        build = self._build_vmap if backend == "vmap" else self._build_map
+        self._batched_fn = jax.jit(
+            self.retrace.wrap("batch_round_fn", build()),
+            donate_argnums=(0,),
+        )
+        # last per-lane metric rows ([N, ...] device arrays, () when off)
+        self.last_fault_metrics = ()
+        self.last_defense_metrics = ()
+        self.last_service_metrics = ()
+        self.last_forensic_metrics = ()
+
+    @staticmethod
+    def _carry_of(t):
+        return (
+            t.flat_params, t.server_opt_state, t.client_m, t.fault_state,
+            t.defense_state, t.attack_iter, t.service_state,
+        )
+
+    def _one(self, carry, base_key, knobs, round_idx):
+        template = self.template
+        with _bound(template, knobs):
+            round_key = jax.random.fold_in(base_key, round_idx)
+            return template._round_core(
+                *carry, round_key, template.x_train, template.y_train
+            )
+
+    def _build_vmap(self):
+        def batched(carry, base_keys, knobs, round_idx):
+            return jax.vmap(
+                self._one, in_axes=(0, 0, 0, None)
+            )(carry, base_keys, knobs, round_idx)
+
+        return batched
+
+    def _build_map(self):
+        def batched(carry, base_keys, knobs, round_idx):
+            def elem(args):
+                c, k, kn = args
+                return self._one(c, k, kn, round_idx)
+
+            return jax.lax.map(elem, (carry, base_keys, knobs))
+
+        return batched
+
+    # -------------------------------------------------------- execution
+
+    def run_round(self, round_idx: int):
+        """One batched round; returns the per-lane honest-dispersion
+        metric ``[N]`` as a device array (no host sync — the solo
+        ``run_round`` discipline)."""
+        out = self._batched_fn(
+            self.carry, self.base_keys, self.knobs, jnp.int32(round_idx)
+        )
+        self.carry = tuple(out[:7])
+        (
+            variance, self.last_fault_metrics, self.last_defense_metrics,
+            self.last_service_metrics, self.last_forensic_metrics,
+        ) = out[7:12]
+        return variance
+
+    def lane_params(self, lane: int):
+        return self.carry[0][lane]
+
+    def evaluate(self, lane: int, split: str = "val"):
+        """Per-lane eval through the TEMPLATE's jitted eval fn (one
+        lowering for every lane; chunk cache shared — lanes share one
+        dataset by contract)."""
+        t = self.template
+        if split not in t._eval_cache:
+            ds = t.dataset
+            arrs = (
+                (ds.x_val, ds.y_val) if split == "val"
+                else (ds.x_train, ds.y_train)
+            )
+            t._eval_cache[split] = t._chunked(*arrs)
+        x, y, m = t._eval_cache[split]
+        loss, acc = t._eval_fn(self.lane_params(lane), x, y, m)
+        return float(loss), float(acc)
+
+    # -------------------------------------------------------- hot swap
+
+    def set_knob(self, lane: int, name: str, value) -> None:
+        """Hot-swap one lane's knob: a pure device-array update, so the
+        next round reuses the existing lowering (RetraceDetector-gated by
+        callers).  Raises ``KeyError`` for knobs that are not traced data
+        in this batch's config family."""
+        if name not in self.knobs:
+            raise KeyError(
+                f"knob {name!r} is not traced data for this batch "
+                f"(batchable here: {sorted(self.knobs)}); structural "
+                f"knobs cannot be hot-swapped without a retrace"
+            )
+        if not 0 <= lane < self.n:
+            raise IndexError(f"lane {lane} out of range [0, {self.n})")
+        arr = self.knobs[name]
+        self.knobs[name] = arr.at[lane].set(
+            jnp.asarray(value, dtype=arr.dtype)
+        )
+
+    def cancel(self, lane: int) -> None:
+        """Stop recording/evaluating a lane.  The lane's compute still
+        rides the batch (masking it out would change nothing — the
+        program is shape-static) but it stops producing records, events,
+        or evals; when every lane is cancelled the driver loop exits."""
+        self.active[lane] = False
+
+    # -------------------------------------------------------- driver
+
+    def _init_paths(self, lane: int) -> Dict[str, list]:
+        cfg = self.cfgs[lane]
+        t = self.trainers[lane]
+        if cfg.eval_train:
+            tr_loss, tr_acc = self.evaluate(lane, "train")
+        else:
+            tr_loss, tr_acc = (0.0, 0.0)
+        va_loss, va_acc = self.evaluate(lane, "val")
+        paths: Dict[str, list] = {
+            "trainLossPath": [tr_loss],
+            "trainAccPath": [tr_acc],
+            "valLossPath": [va_loss],
+            "valAccPath": [va_acc],
+            "variencePath": [],  # sic — reference spelling
+            "roundsPerSec": [],
+        }
+        if t.fault is not None:
+            paths["faultDroppedPath"] = []
+            paths["faultErasedPath"] = []
+            paths["faultCorruptPath"] = []
+            paths["effectiveKPath"] = []
+        if t.defense is not None:
+            for path_key in defense_events.PATH_KEYS.values():
+                paths[path_key] = []
+        if cfg.service == "on":
+            paths["serviceAvailPath"] = []
+            paths["serviceAbsentPath"] = []
+            paths["serviceLatePath"] = []
+            paths["effectiveKPath"] = []
+        return paths
+
+    def train(
+        self,
+        log_fn: Optional[Callable[[str], None]] = None,
+        obs_list: Optional[Sequence["obs_lib.Observability"]] = None,
+        start_round: int = 0,
+        before_round: Optional[Callable[[int], None]] = None,
+    ) -> List[Dict[str, list]]:
+        """Drive every lane to ``cfg.rounds``; returns per-lane paths
+        dicts mirroring ``FedTrainer.train`` (same keys, same float
+        conversions — the bit-identity surface).  ``obs_list`` supplies
+        one Observability per lane (None entries allowed);
+        ``before_round(r)`` runs at each round boundary — the control
+        plane applies queued knob swaps and cancellations there."""
+        log = log_fn or (lambda s: None)
+        obs_list = list(obs_list) if obs_list else [None] * self.n
+        cfg0 = self.cfgs[0]
+        paths_list = [self._init_paths(i) for i in range(self.n)]
+        prev_rung = [
+            int(t.defense_state[1][0]) if t.defense is not None else None
+            for t in self.trainers
+        ]
+        for r in range(start_round, cfg0.rounds):
+            if before_round is not None:
+                before_round(r)
+            if not any(self.active):
+                break
+            before = self.retrace.count("batch_round_fn")
+            t0 = time.perf_counter()
+            variance = self.run_round(r)
+            jax.block_until_ready(self.carry[0])
+            compiled = self.retrace.count("batch_round_fn") > before
+            dt = time.perf_counter() - t0
+            var_np = np.asarray(variance)
+            fm_np = (
+                np.asarray(self.last_fault_metrics)
+                if self.template.fault is not None else None
+            )
+            dm_np = (
+                np.asarray(self.last_defense_metrics)
+                if self.template.defense is not None else None
+            )
+            sm_np = (
+                np.asarray(self.last_service_metrics)
+                if cfg0.service == "on" else None
+            )
+            for i in range(self.n):
+                if not self.active[i]:
+                    continue
+                self._record_lane(
+                    i, r, float(var_np[i]),
+                    None if fm_np is None else fm_np[i],
+                    None if dm_np is None else dm_np[i],
+                    None if sm_np is None else sm_np[i],
+                    dt, compiled, paths_list[i], obs_list[i], prev_rung,
+                    log,
+                )
+        return paths_list
+
+    def _record_lane(
+        self, i, r, var_f, fault_row, defense_row, service_row, dt,
+        compiled, paths, obs, prev_rung, log,
+    ) -> None:
+        cfg = self.cfgs[i]
+        t = self.trainers[i]
+        obs = obs or obs_lib.NULL
+        if cfg.eval_train:
+            tr_loss, tr_acc = self.evaluate(i, "train")
+        else:
+            tr_loss, tr_acc = (0.0, 0.0)
+        va_loss, va_acc = self.evaluate(i, "val")
+        paths["trainLossPath"].append(tr_loss)
+        paths["trainAccPath"].append(tr_acc)
+        paths["valLossPath"].append(va_loss)
+        paths["valAccPath"].append(va_acc)
+        paths["variencePath"].append(var_f)
+        paths["roundsPerSec"].append(1.0 / dt)
+        fault_metrics = None
+        if fault_row is not None:
+            dropped, erased, corrupt, eff_k = (float(v) for v in fault_row)
+            paths["faultDroppedPath"].append(dropped)
+            paths["faultErasedPath"].append(erased)
+            paths["faultCorruptPath"].append(corrupt)
+            paths["effectiveKPath"].append(eff_k)
+            fault_metrics = {
+                "dropped": dropped, "erased": erased, "corrupt": corrupt,
+                "effective_k": eff_k,
+            }
+        service_metrics = None
+        if service_row is not None:
+            avail_m, absent_m, late_m, eff_k = (
+                float(v) for v in service_row
+            )
+            paths["serviceAvailPath"].append(avail_m)
+            paths["serviceAbsentPath"].append(absent_m)
+            paths["serviceLatePath"].append(late_m)
+            paths["effectiveKPath"].append(eff_k)
+            service_metrics = {
+                "available": avail_m, "absent": absent_m, "late": late_m,
+                "effective_k": eff_k,
+            }
+            obs.emit("participation", round=r, **service_metrics)
+        if defense_row is not None:
+            dmetrics = defense_events.round_metrics(defense_row)
+            for dkey, path_key in defense_events.PATH_KEYS.items():
+                paths[path_key].append(dmetrics[dkey])
+            agg_name = defense_events.active_agg(
+                t.defense.mode, t.defense.ladder,
+                int(dmetrics["rung"]), cfg.agg,
+            )
+            defense_events.emit_round(
+                obs, r, mode=t.defense.mode, agg=agg_name,
+                metrics=dmetrics, prev_rung=prev_rung[i],
+            )
+            prev_rung[i] = int(dmetrics["rung"])
+        if t._forensics_on and obs.enabled:
+            forensics_lib.emit_round_flags(
+                obs, r, np.asarray(self.last_forensic_metrics[i]),
+                mode=cfg.forensics,
+            )
+        obs.round(
+            r,
+            train_loss=tr_loss, train_acc=tr_acc,
+            val_loss=va_loss, val_acc=va_acc,
+            variance=var_f, round_secs=dt, rounds_per_sec=1.0 / dt,
+            compiled=compiled,
+            fault_metrics=fault_metrics, service_metrics=service_metrics,
+        )
+        log(
+            f"[lane {i}][{r + 1}/{cfg.rounds}] "
+            f"val: loss={va_loss:.4f} acc={va_acc:.4f}"
+        )

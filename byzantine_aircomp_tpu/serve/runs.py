@@ -1,0 +1,356 @@
+"""Run registry + scheduler: the control plane behind the experiment server.
+
+A :class:`RunManager` owns the lifecycle of every submitted run:
+
+* ``submit`` assigns a ``run_id``, rebases the config's output paths onto
+  the run's private subtree (``harness.run_namespace`` — the tenancy
+  boundary), opens the run's own event stream, and queues it under its
+  :func:`~.batch.static_signature`.
+* The scheduler (a background thread started by :meth:`start`, or a
+  direct :meth:`drain` call from tests) groups queued runs by signature
+  and executes each group through ONE shared :class:`~.batch.BatchRunner`
+  — that grouping is what turns 64 tenant submissions into a single XLA
+  lowering.
+* Between rounds (the BatchRunner's ``before_round`` hook) queued knob
+  swaps and cancellations land: a swap is a per-lane device-array update
+  (``set_knob`` — never a retrace, and the post-group lowering count is
+  recorded on every run so the guarantee is auditable per tenant), a
+  cancel flips the lane dark (compute still rides the batch; recording
+  stops).
+
+Every tenant-visible state change is an audit event in the run's own
+stream — ``run_submitted`` / ``knob_swap`` / ``run_cancelled`` (schema
+v4) — and, when the manager was given a shared registry, every run's
+metrics land under its own ``run_id`` label via
+:class:`~..obs.metrics.LabeledRegistry`, so one ``/metrics`` scrape shows
+all tenants side by side.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from .. import obs as obs_lib
+from ..fed import harness
+from ..fed.config import FedConfig
+from .batch import BatchRunner, applicable_knobs, static_signature
+
+#: terminal statuses — no further transitions, obs stream closed
+_DONE = ("completed", "cancelled", "failed")
+
+
+class Run:
+    """One tenant run: config + lifecycle + its private output subtree.
+
+    Not self-locking — the manager's lock guards every mutation (the
+    scheduler thread and HTTP handler threads both touch runs).
+    """
+
+    def __init__(self, run_id: str, cfg: FedConfig, signature: str) -> None:
+        self.run_id = run_id
+        self.cfg = cfg
+        self.signature = signature
+        self.title = harness.ckpt_title(cfg)
+        self.status = "queued"
+        self.round = 0  # last round boundary reached while running
+        self.lane: Optional[int] = None
+        self.error: Optional[str] = None
+        self.lowerings: Optional[int] = None
+        self.swaps: List[tuple] = []  # pending (knob, value), applied between rounds
+        self.applied_swaps: List[dict] = []
+        self.cancel_requested = False
+        self.paths: Optional[Dict[str, list]] = None
+        self.obs: obs_lib.Observability = obs_lib.NULL
+
+    def info(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "run_id": self.run_id,
+            "title": self.title,
+            "signature": self.signature,
+            "status": self.status,
+            "round": self.round,
+            "rounds": self.cfg.rounds,
+            "lane": self.lane,
+            "obs_dir": self.cfg.obs_dir,
+            "checkpoint_dir": self.cfg.checkpoint_dir,
+            "knobs": {
+                k: getattr(self.cfg, k)
+                for k in ("seed",) + tuple(applicable_knobs(self.cfg))
+            },
+            "swaps": list(self.applied_swaps),
+        }
+        if self.lowerings is not None:
+            d["lowerings"] = self.lowerings
+        if self.error is not None:
+            d["error"] = self.error
+        if self.paths and self.paths.get("valLossPath"):
+            d["val_loss"] = self.paths["valLossPath"][-1]
+            d["val_acc"] = self.paths["valAccPath"][-1]
+        return d
+
+
+class RunManager:
+    """Thread-safe run registry + signature-grouped batch scheduler."""
+
+    def __init__(
+        self,
+        obs_root: str,
+        registry=None,
+        dataset=None,
+        backend: str = "vmap",
+        batch_window: float = 0.25,
+    ) -> None:
+        self.obs_root = obs_root
+        self.registry = registry
+        self._dataset = dataset
+        self._backend = backend
+        self._batch_window = batch_window
+        self._lock = threading.RLock()
+        self._runs: Dict[str, Run] = {}
+        self._order: List[str] = []
+        self._pending: List[str] = []
+        self._seq = 0
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._dataset_cache: Dict[str, Any] = {}
+
+    # ---------------------------------------------------------- registry
+
+    def submit(self, cfg: FedConfig) -> str:
+        """Register + queue one run; returns its server-assigned id.
+
+        The run's event stream opens HERE so ``run_submitted`` is the
+        stream's first event and a crash between submit and execution
+        still leaves an audit trail."""
+        with self._lock:
+            self._seq += 1
+            run_id = f"run-{self._seq:04d}"
+            cfg = harness.run_namespace(cfg, run_id, self.obs_root)
+            run = Run(run_id, cfg, static_signature(cfg))
+            sink: obs_lib.EventSink = obs_lib.JsonlSink(
+                obs_lib.events_path(cfg.obs_dir, run.title)
+            )
+            if self.registry is not None:
+                labeled = obs_lib.LabeledRegistry(self.registry, run_id=run_id)
+                sink = obs_lib.MultiSink(
+                    [sink, obs_lib.MetricsSink(labeled)]
+                )
+            run.obs = obs_lib.Observability(sink)
+            run.obs.emit(
+                "run_submitted",
+                run_id=run_id, title=run.title, signature=run.signature,
+            )
+            self._runs[run_id] = run
+            self._order.append(run_id)
+            self._pending.append(run_id)
+        self._wake.set()
+        return run_id
+
+    def _get(self, run_id: str) -> Run:
+        run = self._runs.get(run_id)
+        if run is None:
+            raise KeyError(f"no such run {run_id!r}")
+        return run
+
+    def get(self, run_id: str) -> Dict[str, Any]:
+        with self._lock:
+            return self._get(run_id).info()
+
+    def list_runs(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [self._runs[rid].info() for rid in self._order]
+
+    def cancel(self, run_id: str) -> Dict[str, Any]:
+        """Cancel a run.  Queued runs finalize immediately; running runs
+        go dark at the next round boundary (idempotent on done runs)."""
+        with self._lock:
+            run = self._get(run_id)
+            if run.status in _DONE:
+                return run.info()
+            run.cancel_requested = True
+            if run.status == "queued":
+                run.status = "cancelled"
+                run.obs.emit("run_cancelled", run_id=run_id, round=0)
+                run.obs.close()
+            return run.info()
+
+    def swap(self, run_id: str, knob: str, value) -> Dict[str, Any]:
+        """Hot-swap one batchable knob.  Queued runs take the new value
+        into their initial knob stack; running runs get a per-lane
+        device-array update at the next round boundary.  Raises
+        ``ValueError`` for non-batchable knobs or done runs."""
+        with self._lock:
+            run = self._get(run_id)
+            if run.status in _DONE:
+                raise ValueError(
+                    f"run {run_id} is {run.status}; knobs can only be "
+                    f"swapped on queued/running runs"
+                )
+            allowed = applicable_knobs(run.cfg)
+            if knob not in allowed:
+                raise ValueError(
+                    f"knob {knob!r} is not hot-swappable for this run "
+                    f"(batchable here: {sorted(allowed)}); structural "
+                    f"knobs need a new run"
+                )
+            value = float(value)
+            if run.status == "queued":
+                # the batch doesn't exist yet — the new value simply
+                # becomes the lane's initial knob (gather_knobs reads cfg)
+                setattr(run.cfg, knob, value)
+                run.applied_swaps.append(
+                    {"round": 0, "knob": knob, "value": value}
+                )
+                run.obs.emit(
+                    "knob_swap",
+                    run_id=run_id, round=0, knob=knob, value=value,
+                )
+            else:
+                run.swaps.append((knob, value))
+            return run.info()
+
+    # --------------------------------------------------------- scheduler
+
+    def start(self) -> "RunManager":
+        """Start the background scheduler (the server's mode).  Waits
+        ``batch_window`` seconds after a submission before draining so
+        concurrent tenants coalesce into one batch."""
+        with self._lock:
+            if self._thread is None:
+                self._stop = False
+                self._thread = threading.Thread(
+                    target=self._loop,
+                    name="aircomp-run-scheduler",
+                    daemon=True,
+                )
+                self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop = True
+        self._wake.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=30.0)
+            self._thread = None
+        with self._lock:
+            for rid in self._order:
+                run = self._runs[rid]
+                if run.status not in _DONE:
+                    run.obs.close()
+
+    def _loop(self) -> None:
+        while not self._stop:
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            if self._stop:
+                break
+            if self._pending:
+                time.sleep(self._batch_window)
+                try:
+                    self.drain()
+                except Exception:  # keep the scheduler alive; runs record
+                    traceback.print_exc()  # their own failure status
+
+    def drain(self) -> None:
+        """Execute every currently-queued run, grouped by signature into
+        one BatchRunner per group.  Blocks until done.  Tests call this
+        directly for deterministic grouping; the scheduler thread calls
+        it after the batch window."""
+        while True:
+            with self._lock:
+                pending = [
+                    self._runs[rid]
+                    for rid in self._pending
+                    if self._runs[rid].status == "queued"
+                ]
+                self._pending = []
+                groups: Dict[str, List[Run]] = {}
+                for run in pending:
+                    run.status = "running"
+                    groups.setdefault(run.signature, []).append(run)
+            if not groups:
+                return
+            for runs in groups.values():
+                self._run_group(runs)
+
+    def _dataset_for(self, name: str):
+        if self._dataset is not None:
+            return self._dataset
+        if name not in self._dataset_cache:
+            from ..data import datasets as data_lib
+
+            self._dataset_cache[name] = data_lib.load(name)
+        return self._dataset_cache[name]
+
+    def _fail(self, runs: List[Run], exc: BaseException) -> None:
+        with self._lock:
+            for run in runs:
+                if run.status not in _DONE:
+                    run.status = "failed"
+                    run.error = f"{type(exc).__name__}: {exc}"
+                run.obs.close()
+
+    def _run_group(self, runs: List[Run]) -> None:
+        try:
+            dataset = self._dataset_for(runs[0].cfg.dataset)
+            batch = BatchRunner(
+                [r.cfg for r in runs],
+                dataset=dataset,
+                backend=self._backend,
+            )
+        except Exception as exc:
+            self._fail(runs, exc)
+            return
+        with self._lock:
+            for lane, run in enumerate(runs):
+                run.lane = lane
+
+        def before_round(rnd: int) -> None:
+            with self._lock:
+                for run in runs:
+                    if run.status != "running":
+                        continue
+                    if run.cancel_requested:
+                        batch.cancel(run.lane)
+                        run.status = "cancelled"
+                        run.obs.emit(
+                            "run_cancelled", run_id=run.run_id, round=rnd
+                        )
+                        run.swaps = []
+                        continue
+                    for knob, value in run.swaps:
+                        batch.set_knob(run.lane, knob, value)
+                        setattr(run.cfg, knob, value)
+                        run.applied_swaps.append(
+                            {"round": rnd, "knob": knob, "value": value}
+                        )
+                        run.obs.emit(
+                            "knob_swap",
+                            run_id=run.run_id, round=rnd,
+                            knob=knob, value=value,
+                        )
+                    run.swaps = []
+                    run.round = rnd
+
+        try:
+            paths_list = batch.train(
+                obs_list=[r.obs for r in runs],
+                before_round=before_round,
+            )
+        except Exception as exc:
+            self._fail(runs, exc)
+            return
+        lowerings = batch.retrace.count("batch_round_fn")
+        with self._lock:
+            for run, paths in zip(runs, paths_list):
+                run.paths = paths
+                run.lowerings = lowerings
+                if run.status == "running":
+                    run.status = "completed"
+                    run.round = run.cfg.rounds
+                run.obs.close()

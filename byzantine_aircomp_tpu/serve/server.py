@@ -1,0 +1,151 @@
+"""The experiment server: a stdlib HTTP control plane over one port.
+
+Rides the :class:`~..obs.exporter.MetricsExporter` routes hook, so a
+single socket serves both surfaces — the Prometheus scrape endpoints the
+repo already had (``/metrics``, ``/healthz``) and the multi-tenant run
+API this module adds:
+
+* ``POST /runs``              — submit a run (body: FedConfig overrides
+  as JSON; same coercion rules as the CLI's ``--set``); returns 201 with
+  the run's info including its server-assigned ``run_id``
+* ``GET  /runs``              — list every run with status/progress
+* ``GET  /runs/<id>``         — one run's info
+* ``POST /runs/<id>/cancel``  — cancel (queued: immediate; running: the
+  lane goes dark at the next round boundary)
+* ``POST /runs/<id>/knobs``   — hot-swap batchable knobs between rounds
+  (body: ``{"gamma": 0.05, ...}``); a swap is a per-lane device-array
+  update and can never retrace the shared round program
+
+Tenancy: every run writes only under ``<obs_root>/<run_id>/`` (events,
+checkpoints, caches), and its metrics carry a ``run_id`` label in the
+shared registry, so one ``/metrics`` scrape shows
+``aircomp_events_total{kind="round",run_id="run-0001"}`` per tenant.
+Errors map conventionally: unknown run -> 404, contract/knob/body
+violations -> 400 with ``{"error": ...}``.
+
+See docs/SERVING.md for the API walk-through and the batchable-knob
+contract (what may differ across runs sharing one compiled trainer).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Tuple
+
+from .. import obs as obs_lib
+from ..fed.config import config_from_mapping
+from .runs import RunManager
+
+_JSON = "application/json"
+
+
+class ExperimentServer:
+    """RunManager + shared metrics registry + one HTTP surface."""
+
+    def __init__(
+        self,
+        obs_root: str,
+        port: int = 0,
+        host: str = "0.0.0.0",
+        dataset=None,
+        backend: str = "vmap",
+        batch_window: float = 0.25,
+    ) -> None:
+        self.registry = obs_lib.MetricsRegistry()
+        self.manager = RunManager(
+            obs_root,
+            registry=self.registry,
+            dataset=dataset,
+            backend=backend,
+            batch_window=batch_window,
+        )
+        self.exporter = obs_lib.MetricsExporter(
+            self.registry,
+            port=port,
+            host=host,
+            health_fn=self._health,
+            routes=self._routes,
+        )
+
+    @property
+    def port(self) -> Optional[int]:
+        return self.exporter.port
+
+    def start(self) -> "ExperimentServer":
+        self.manager.start()
+        self.exporter.start()
+        return self
+
+    def close(self) -> None:
+        self.exporter.close()
+        self.manager.close()
+
+    def __enter__(self) -> "ExperimentServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ routes
+
+    def _health(self) -> Dict[str, Any]:
+        counts: Dict[str, int] = {}
+        for info in self.manager.list_runs():
+            counts[info["status"]] = counts.get(info["status"], 0) + 1
+        return {"ok": True, "runs": counts}
+
+    @staticmethod
+    def _json(status: int, payload: Any) -> Tuple[int, str, bytes]:
+        return status, _JSON, (json.dumps(payload) + "\n").encode()
+
+    def _routes(
+        self, method: str, path: str, body: bytes
+    ) -> Optional[Tuple[int, str, bytes]]:
+        """The exporter's extra-route hook; ``None`` falls through to the
+        built-in ``/metrics``/``/healthz`` handling."""
+        try:
+            return self._dispatch(method, path, body)
+        except KeyError as exc:
+            return self._json(404, {"error": str(exc).strip("'\"")})
+        except ValueError as exc:  # includes json.JSONDecodeError
+            return self._json(400, {"error": str(exc)})
+        except Exception as exc:  # noqa: BLE001 — surface, don't kill the thread
+            return self._json(
+                500, {"error": f"{type(exc).__name__}: {exc}"}
+            )
+
+    def _dispatch(
+        self, method: str, path: str, body: bytes
+    ) -> Optional[Tuple[int, str, bytes]]:
+        parts = [p for p in path.split("/") if p]
+        if not parts or parts[0] != "runs":
+            return None
+        mgr = self.manager
+        if len(parts) == 1:
+            if method == "POST":
+                overrides = json.loads(body.decode() or "{}")
+                if not isinstance(overrides, dict):
+                    raise ValueError(
+                        "POST /runs body must be a JSON object of "
+                        "FedConfig overrides"
+                    )
+                run_id = mgr.submit(config_from_mapping(overrides))
+                return self._json(201, mgr.get(run_id))
+            if method == "GET":
+                return self._json(200, {"runs": mgr.list_runs()})
+        elif len(parts) == 2 and method == "GET":
+            return self._json(200, mgr.get(parts[1]))
+        elif len(parts) == 3 and parts[2] == "cancel" and method == "POST":
+            return self._json(200, mgr.cancel(parts[1]))
+        elif len(parts) == 3 and parts[2] == "knobs" and method == "POST":
+            swaps = json.loads(body.decode() or "{}")
+            if not isinstance(swaps, dict) or not swaps:
+                raise ValueError(
+                    "POST /runs/<id>/knobs body must be a non-empty JSON "
+                    "object {knob: value}"
+                )
+            info = None
+            for knob, value in swaps.items():
+                info = mgr.swap(parts[1], knob, value)
+            return self._json(200, info)
+        return None

@@ -42,6 +42,7 @@ def _fingerprint(required: dict, key_map: dict) -> str:
 GOLDEN = {
     2: "a5033a62e61ad318",
     3: "b654d31431900f5b",
+    4: "1e58b7097dea230e",
 }
 
 

@@ -1,0 +1,330 @@
+"""Multi-tenant experiment server: batch contract, bit-identity,
+hot-swap, the RunManager lifecycle, and the HTTP surface.
+
+The acceptance surface of serve/ (docs/SERVING.md): N same-shape configs
+share ONE lowering (`batch_round_fn` retrace count), seed-only batches
+are bit-identical to solo runs, knob hot-swaps between rounds never
+retrace, and every tenant gets an isolated obs/checkpoint namespace plus
+run_id-labelled metrics on the shared scrape endpoint.
+"""
+
+import json
+import os
+import pickle
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from byzantine_aircomp_tpu.fed.config import FedConfig, config_from_mapping
+
+
+@pytest.fixture
+def synthetic_mnist(monkeypatch):
+    import byzantine_aircomp_tpu.data.datasets as dl
+
+    orig = dl.load
+    monkeypatch.setattr(
+        dl, "load",
+        lambda name, **kw: orig(name, synthetic_train=600, synthetic_val=200),
+    )
+
+
+def _cfg(**kw):
+    base = dict(
+        dataset="mnist", honest_size=6, byz_size=0, rounds=2,
+        display_interval=2, batch_size=16, agg="mean", eval_train=False,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+# ------------------------------------------------- batch contract
+
+
+def test_validate_batch_rejects_structural_mismatch():
+    from byzantine_aircomp_tpu.serve.batch import validate_batch
+
+    with pytest.raises(ValueError, match="honest_size"):
+        validate_batch([_cfg(seed=1), _cfg(seed=2, honest_size=8)])
+    with pytest.raises(ValueError, match="agg"):
+        validate_batch([_cfg(), _cfg(agg="trimmed_mean")])
+    with pytest.raises(ValueError, match="cohort"):
+        validate_batch([_cfg(cohort_size=4, cohort_quantile=0.5)])
+
+
+def test_validate_batch_rejects_dirichlet_seed_mix():
+    from byzantine_aircomp_tpu.serve.batch import validate_batch
+
+    mk = lambda s: _cfg(partition="dirichlet", dirichlet_alpha=0.5, seed=s)
+    with pytest.raises(ValueError, match="dirichlet"):
+        validate_batch([mk(1), mk(2)])
+    validate_batch([mk(1), mk(1)])  # same seed: fine
+
+
+def test_batchable_knobs_gate_on_feature_flags():
+    from byzantine_aircomp_tpu.serve.batch import applicable_knobs
+
+    plain = applicable_knobs(_cfg())
+    assert "gamma" in plain and "defense_z" not in plain
+    defended = applicable_knobs(
+        _cfg(byz_size=2, attack="signflip", defense="adaptive",
+             defense_ladder="mean,trimmed_mean,median")
+    )
+    assert "defense_z" in defended and "attack_param" not in defended
+
+
+def test_static_signature_groups_seed_batches():
+    from byzantine_aircomp_tpu.serve.batch import static_signature
+
+    assert static_signature(_cfg(seed=1)) == static_signature(_cfg(seed=2))
+    assert static_signature(_cfg()) != static_signature(_cfg(honest_size=8))
+
+
+def test_config_from_mapping_round_trip_and_errors():
+    cfg = config_from_mapping(
+        {"dataset": "mnist", "honest_size": 6, "rounds": "3", "gamma": 0.5}
+    )
+    assert cfg.honest_size == 6 and cfg.rounds == 3 and cfg.gamma == 0.5
+    with pytest.raises(ValueError, match="bogus"):
+        config_from_mapping({"bogus": 1})
+
+
+# ---------------------------------------- bit-identity + one lowering
+
+
+def test_seed_batch_bit_identical_to_solo(synthetic_mnist):
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+    from byzantine_aircomp_tpu.serve.batch import BatchRunner
+
+    cfgs = [_cfg(rounds=3, seed=s) for s in (11, 12, 13)]
+    batch = BatchRunner(cfgs)
+    batch_paths = batch.train()
+    assert batch.retrace.count("batch_round_fn") == 1
+    for cfg, bp in zip(cfgs, batch_paths):
+        solo = FedTrainer(cfg).train()
+        solo.pop("roundsPerSec")
+        bp = dict(bp)
+        bp.pop("roundsPerSec")
+        assert pickle.dumps(solo) == pickle.dumps(bp)
+
+
+def test_hot_swap_changes_behavior_without_relowering(synthetic_mnist):
+    import numpy as np
+
+    from byzantine_aircomp_tpu.serve.batch import BatchRunner
+
+    swapped = BatchRunner([_cfg(rounds=4, seed=1), _cfg(rounds=4, seed=2)])
+    control = BatchRunner([_cfg(rounds=4, seed=1), _cfg(rounds=4, seed=2)])
+    for b in (swapped, control):
+        b.run_round(0)
+    swapped.set_knob(1, "gamma", 0.5)
+    for b in (swapped, control):
+        b.run_round(1)
+    assert swapped.retrace.count("batch_round_fn") == 1  # no retrace
+    # lane 0 untouched by the swap, lane 1 diverges
+    assert np.allclose(swapped.lane_params(0), control.lane_params(0))
+    assert not np.allclose(swapped.lane_params(1), control.lane_params(1))
+    with pytest.raises(KeyError, match="attack_param"):
+        swapped.set_knob(0, "attack_param", 2.0)
+
+
+# ------------------------------------------------- RunManager
+
+
+def test_64_concurrent_runs_one_lowering(tmp_path, synthetic_mnist):
+    """Acceptance bar: 64 tiny runs through one manager, exactly one
+    round-fn lowering shared across all of them."""
+    from byzantine_aircomp_tpu.serve.runs import RunManager
+
+    mgr = RunManager(str(tmp_path / "root"))
+    ids = [mgr.submit(_cfg(rounds=2, seed=s)) for s in range(64)]
+    assert len(set(ids)) == 64
+    mgr.drain()
+    infos = [mgr.get(rid) for rid in ids]
+    assert all(i["status"] == "completed" for i in infos)
+    assert all(i["lowerings"] == 1 for i in infos)
+    assert len({i["signature"] for i in infos}) == 1
+
+
+def test_queued_cancel_and_queued_swap(tmp_path, synthetic_mnist):
+    from byzantine_aircomp_tpu.serve.runs import RunManager
+
+    mgr = RunManager(str(tmp_path / "root"))
+    keep = mgr.submit(_cfg(seed=1))
+    gone = mgr.submit(_cfg(seed=2))
+    mgr.swap(keep, "gamma", 0.25)
+    info = mgr.cancel(gone)
+    assert info["status"] == "cancelled"
+    mgr.drain()
+    assert mgr.get(keep)["status"] == "completed"
+    assert mgr.get(keep)["knobs"]["gamma"] == 0.25
+    assert mgr.get(gone)["status"] == "cancelled"  # never trained
+    with pytest.raises(ValueError):
+        mgr.swap(keep, "gamma", 0.1)  # done runs reject swaps
+    with pytest.raises(KeyError):
+        mgr.get("run-9999")
+
+
+def test_concurrent_submits_isolated_namespaces(tmp_path, synthetic_mnist):
+    from byzantine_aircomp_tpu.serve.runs import RunManager
+
+    mgr = RunManager(str(tmp_path / "root"))
+    ids: list = []
+    lock = threading.Lock()
+
+    def submit_n(seed0):
+        for s in range(4):
+            rid = mgr.submit(_cfg(seed=seed0 + s))
+            with lock:
+                ids.append(rid)
+
+    threads = [threading.Thread(target=submit_n, args=(b,)) for b in (0, 100)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(ids)) == 8
+    mgr.drain()
+    for rid in ids:
+        info = mgr.get(rid)
+        assert info["status"] == "completed"
+        run_dir = tmp_path / "root" / rid
+        events = [
+            f for f in os.listdir(run_dir) if f.endswith(".events.jsonl")
+        ]
+        assert len(events) == 1
+        lines = [
+            json.loads(l) for l in open(run_dir / events[0])
+        ]
+        assert lines[0]["kind"] == "run_submitted"
+        assert lines[0]["run_id"] == rid
+        assert sum(e["kind"] == "round" for e in lines) == 2
+
+
+# ------------------------------------------------- metrics tenancy
+
+
+def test_labeled_registry_stamps_run_id():
+    from byzantine_aircomp_tpu.obs.metrics import (
+        LabeledRegistry, MetricsRegistry,
+    )
+
+    base = MetricsRegistry()
+    a = LabeledRegistry(base, run_id="run-a")
+    b = LabeledRegistry(base, run_id="run-b")
+    a.inc("aircomp_events_total", kind="round")
+    a.inc("aircomp_events_total", kind="round")
+    b.inc("aircomp_events_total", kind="round")
+    assert a.value("aircomp_events_total", kind="round") == 2
+    assert b.value("aircomp_events_total", kind="round") == 1
+    text = base.render()
+    assert 'run_id="run-a"' in text and 'run_id="run-b"' in text
+
+
+# ------------------------------------------------- HTTP surface
+
+
+def _req(base, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(base + path, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read() or b"{}")
+
+
+def test_server_endpoint_e2e(tmp_path, synthetic_mnist):
+    """submit -> scrape /runs -> per-run metrics labels -> cancel, over
+    real HTTP against an ephemeral port."""
+    import time
+
+    from byzantine_aircomp_tpu.serve.server import ExperimentServer
+
+    tiny = dict(
+        dataset="mnist", honest_size=6, byz_size=0, rounds=3,
+        display_interval=2, batch_size=16, agg="mean", eval_train=False,
+    )
+    with ExperimentServer(
+        str(tmp_path / "root"), port=0, host="127.0.0.1", batch_window=0.05
+    ).start() as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        s1, r1 = _req(base, "POST", "/runs", {**tiny, "seed": 1})
+        s2, r2 = _req(base, "POST", "/runs", {**tiny, "seed": 2})
+        assert s1 == 201 and s2 == 201
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            _, listing = _req(base, "GET", "/runs")
+            statuses = [r["status"] for r in listing["runs"]]
+            if all(s in ("completed", "failed") for s in statuses):
+                break
+            time.sleep(0.2)
+        assert statuses == ["completed", "completed"]
+        info = _req(base, "GET", f"/runs/{r1['run_id']}")[1]
+        assert info["lowerings"] == 1
+        assert info["val_acc"] is not None
+        # error mapping
+        assert _req(base, "GET", "/runs/absent")[0] == 404
+        assert _req(base, "POST", "/runs", {"bogus": 1})[0] == 400
+        assert _req(base, "POST", f"/runs/{r1['run_id']}/knobs",
+                    {"gamma": 0.5})[0] == 400  # done run
+        # cancel on a done run is idempotent
+        assert _req(base, "POST", f"/runs/{r2['run_id']}/cancel")[0] == 200
+        # shared scrape endpoint, per-tenant labels
+        metrics = urllib.request.urlopen(
+            base + "/metrics", timeout=30
+        ).read().decode()
+        for rid in (r1["run_id"], r2["run_id"]):
+            assert (
+                f'aircomp_events_total{{kind="round",run_id="{rid}"}}'
+                in metrics
+            )
+        health = json.loads(
+            urllib.request.urlopen(base + "/healthz", timeout=30).read()
+        )
+        assert health["runs"].get("completed") == 2
+
+
+# ------------------------------------------------- batched analysis
+
+
+def test_adaptive_matrix_batched_matches_eager():
+    from byzantine_aircomp_tpu.analysis import adaptive_matrix as am
+
+    attacks = ["signflip", "gradascent", "under_radar"]
+    modes = ["off", "monitor", "adaptive"]
+    kw = dict(iters=10, onset=2, stop=7, seed=0, log=lambda s: None)
+    eager = am.run_matrix(attacks, modes, **kw)
+    batched = am.run_matrix(attacks, modes, batched=True, **kw)
+    assert set(eager) == set(batched)
+    for key, cell in eager.items():
+        bcell = batched[key]
+        assert set(cell) == set(bcell)
+        for col in ("skipped", "detect_iter", "time_to_detect",
+                    "rounds_suspicious", "max_rung", "min_rung_post",
+                    "final_rung", "transitions", "deescalated",
+                    "precision", "recall"):
+            assert cell.get(col) == bcell.get(col), (key, col)
+        for col in ("final_dist", "agg_err"):
+            if col in cell:
+                assert bcell[col] == pytest.approx(cell[col], abs=1e-3)
+
+
+def test_sweep_batched_matches_eager(synthetic_mnist):
+    from byzantine_aircomp_tpu.analysis.sweep import run_sweep
+
+    cfg_kw = dict(
+        dataset="mnist", honest_size=6, byz_size=2, rounds=2,
+        display_interval=2, batch_size=16, gamma=1e-2, seed=3,
+        eval_train=False,
+    )
+    common = dict(seeds=2, log=lambda s: None)
+    eager = run_sweep(["mean"], [None, "signflip"], dict(cfg_kw), **common)
+    batched = run_sweep(
+        ["mean"], [None, "signflip"], dict(cfg_kw), batched=True, **common
+    )
+    for key, cell in eager.items():
+        for col in ("val_acc", "val_loss", "val_acc_std"):
+            assert cell[col] == batched[key][col], (key, col)
