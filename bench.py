@@ -344,6 +344,100 @@ def run_stream_ksweep() -> None:
 
 
 # --------------------------------------------------------------------------
+# signpack mode: packed one-bit sign-channel rows (BENCH_SIGNPACK=1)
+# --------------------------------------------------------------------------
+
+def run_signpack_bench() -> None:
+    """Packed vs unpacked sign-channel rows: one per ``sign_bits``.
+
+    Runs the SAME tiny signmv training config at ``--sign-bits 32``
+    (legacy f32 ballots) and ``--sign-bits 1`` (bit-packed uint32 words +
+    popcount reduce, ``fed/train.py`` packed resident path), emitting
+    rounds/sec plus the ``bytes_moved`` columns from the ``obs/hbm.py``
+    packed model.  Every row carries ``platform`` and — on the packed row
+    — a non-null ``fallback_reason`` whenever the popcount reduce did NOT
+    run the pallas kernel on a TPU (VMEM rejection, or a non-TPU
+    backend), so the perf-smoke CI step can gate the bandwidth claim with
+    ``perf_gate --expect-platform tpu`` and a relay-dead CPU fallback can
+    never land as a green ~32x headline (the BENCH_r02–r05 trap).  Env
+    knobs: ``BENCH_SIGNPACK_K``/``_B``/``_AGG``/``_ROUNDS``.
+    """
+    timed = int(os.environ.get("BENCH_SIGNPACK_ROUNDS", "3"))
+    k = int(os.environ.get("BENCH_SIGNPACK_K", "32"))
+    b = int(os.environ.get("BENCH_SIGNPACK_B", "4"))
+    agg = os.environ.get("BENCH_SIGNPACK_AGG", "signmv")
+
+    import jax
+    import jax.numpy as jnp
+
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+    from byzantine_aircomp_tpu.obs import hbm as hbm_lib
+    from byzantine_aircomp_tpu.ops import pallas_kernels as pk
+
+    platform = jax.default_backend()
+    log(f"signpack: backend={platform} K={k} B={b} agg={agg} timed={timed}")
+    for bits in (32, 1):
+        cfg = FedConfig(
+            honest_size=k - b,
+            byz_size=b,
+            attack="signflip",
+            agg=agg,
+            sign_eta=0.01,
+            sign_bits=bits,
+            rounds=1 + timed,
+            display_interval=1,
+            batch_size=8,
+            eval_train=False,
+        )
+        ds = data_lib.load("mnist", synthetic_train=4 * k, synthetic_val=256)
+        trainer = FedTrainer(cfg, dataset=ds)
+        trainer.run_rounds(0, 1)  # compile + one warmup round
+        float(jnp.sum(trainer.flat_params))
+        t0 = time.perf_counter()
+        trainer.run_rounds(1, timed)
+        float(jnp.sum(trainer.flat_params))  # honest completion barrier
+        dt = time.perf_counter() - t0
+        d = int(trainer.dim)
+
+        fallback = None
+        if bits == 1:
+            # why the packed reduce is NOT the TPU popcount kernel — the
+            # provenance the --expect-platform gate makes unmissable
+            fallback = pk.signpack_fused_reason(k) or (
+                None if platform == "tpu" else
+                f"packed reduce ran the XLA bit-plane realization "
+                f"(backend={platform}, not tpu)"
+            )
+        row = make_bench_row(
+            timed / dt,
+            platform=platform,
+            timed_rounds=timed,
+            fallback_reason=fallback,
+            params={
+                "k": k, "b": b, "agg": agg, "attack": "signflip",
+                "dataset": "mnist", "model": "MLP",
+                # one metric per width: the ledger keys baselines on
+                # (metric, platform, key) and the 1-bit and 32-bit rows
+                # must never average into each other
+                "metric": f"signpack_round_rps_sb{bits}",
+            },
+        )
+        row["d"] = d
+        row["sign_bits"] = bits
+        row["bytes_moved"] = hbm_lib.packed_stack_bytes(k, d, bits)
+        row["bytes_moved_f32"] = hbm_lib.stack_bytes(k, d)
+        log(
+            f"signpack: sb{bits} {timed / dt:.3f} rounds/sec, sign-channel "
+            f"{row['bytes_moved']} B vs f32 {row['bytes_moved_f32']} B "
+            f"({row['bytes_moved'] / row['bytes_moved_f32']:.4f}x)"
+            + (f", fallback_reason={fallback!r}" if fallback else "")
+        )
+        emit_row(row)
+
+
+# --------------------------------------------------------------------------
 # parent: probe + dispatch (never initializes a backend, cannot hang)
 # --------------------------------------------------------------------------
 
@@ -432,6 +526,9 @@ def main() -> None:
         return
     if os.environ.get("BENCH_STREAM_KSWEEP"):
         run_stream_ksweep()
+        return
+    if os.environ.get("BENCH_SIGNPACK"):
+        run_signpack_bench()
         return
 
     def _secs(name: str, default: str) -> float | None:

@@ -83,6 +83,128 @@ def make_stack(key, k: int, d: int, adversarial: bool = False):
     return jax.block_until_ready(w.astype(jnp.float32))
 
 
+def _signpack_bench(args, emit, w, w_adv, backend, on_tpu) -> int:
+    """--signpack mode: the packed one-bit sign reduce vs the f32 vote.
+
+    Three impls over the same [K, d] stack: ``f32_vote`` (the unpacked
+    ``sum(sign(delta))`` baseline — one f32 stack read), ``xla_packed``
+    and ``pallas_packed`` (popcount over the [K, ceil(d/32)] uint32 sign
+    words — ~1/32 of the read bytes).  Timing excludes the pack (the
+    trainer fuses it into the stack materialization; the reduce is the
+    repeated cost being compared), the ``bytes_moved`` columns come from
+    the obs/hbm.py packed model, and a parity row pins the three counts
+    bit-identical plus the ballots-conservation cross-check
+    (sum(counts) == sum(popcount(words)))."""
+    from byzantine_aircomp_tpu.backends import numpy_ref
+    from byzantine_aircomp_tpu.ops import aggregators as agg_lib
+    from byzantine_aircomp_tpu.ops import pallas_kernels as pk
+    from byzantine_aircomp_tpu.obs import hbm as hbm_lib
+
+    k, d = args.k, args.d
+    guess = jnp.zeros((d,), jnp.float32)
+    bytes_f32 = hbm_lib.stack_bytes(k, d) + d * 4
+    stacks = {"random": w, "adversarial": w_adv}
+
+    # parity first: pallas == xla == numpy oracle, ballots conserved
+    worst_ok = True
+    for name, mat in stacks.items():
+        words, k_valid = agg_lib.pack_signs(mat, guess)
+        counts_x = np.asarray(agg_lib.packed_sign_votes(words, d, impl="xla"))
+        counts_p = np.asarray(
+            agg_lib.packed_sign_votes(words, d, impl="pallas")
+        )
+        ref_words, ref_valid = numpy_ref.pack_signs(
+            np.asarray(mat), np.asarray(guess)
+        )
+        counts_ref = numpy_ref.packed_vote_counts(ref_words, d)
+        conserved = int(counts_x.sum()) == int(
+            np.asarray(jax.lax.population_count(words), np.int64).sum()
+        )
+        ok = (
+            (counts_x == counts_p).all()
+            and (counts_x == counts_ref).all()
+            and (np.asarray(words) == ref_words).all()
+            and int(k_valid) == ref_valid
+            and conserved
+        )
+        worst_ok = worst_ok and bool(ok)
+        emit({
+            "metric": "signpack_parity", "stack": name, "k": k, "d": d,
+            "bit_identical": bool(ok), "ballots_conserved": conserved,
+            "k_valid": int(k_valid), "platform": backend,
+        })
+
+    words, k_valid = jax.block_until_ready(agg_lib.pack_signs(w, guess))
+
+    def f32_vote(mat):
+        delta = mat - guess[None, :]
+        finite = jnp.isfinite(delta)
+        return jnp.sum(jnp.where(finite, jnp.sign(delta), 0.0), axis=0)
+
+    impls = {
+        "f32_vote": (jax.jit(f32_vote), (w,), bytes_f32, 32),
+        "xla_packed": (
+            jax.jit(lambda ws: agg_lib.packed_sign_votes(ws, d, impl="xla")),
+            (words,), hbm_lib.packed_vote_hbm_bytes(k, d, "xla"), 1,
+        ),
+        "pallas_packed": (
+            jax.jit(
+                lambda ws: agg_lib.packed_sign_votes(ws, d, impl="pallas")
+            ),
+            (words,), hbm_lib.packed_vote_hbm_bytes(k, d, "pallas"), 1,
+        ),
+    }
+    timing = {}
+    for impl, (fn, operands, bytes_moved, bits) in impls.items():
+        if impl == "pallas_packed" and not (on_tpu or args.time_pallas):
+            mean_ms = best_ms = None  # interpret mode: not timed
+        else:
+            mean_ms, best_ms = bench_one(fn, operands, args.iters)
+        timing[impl] = mean_ms
+        row = {
+            "metric": "signpack_reduce", "impl": impl, "k": k, "d": d,
+            "sign_bits": bits, "bytes_moved": bytes_moved,
+            "bytes_moved_f32": bytes_f32,
+            "bytes_ratio": round(bytes_moved / bytes_f32, 4),
+            "mean_ms": None if mean_ms is None else round(mean_ms, 3),
+            "best_ms": None if best_ms is None else round(best_ms, 3),
+            "unit": "ms", "platform": backend,
+            "fallback_reason": (
+                None if impl != "pallas_packed"
+                else pk.signpack_fused_reason(k)
+                or (None if on_tpu else "interpret mode (no TPU backend)")
+            ),
+        }
+        emit(row)
+        if args.ledger and mean_ms is not None:
+            obs_lib.PerfLedger(args.ledger).append(
+                f"signpack_reduce_ms_{impl}",
+                round(mean_ms, 3),
+                unit="ms", platform=backend,
+                key=obs_lib.config_key({"k": k, "agg": "signmv"}),
+                note="benchmarks/agg_kernels.py --signpack",
+                bytes_moved=bytes_moved, bytes_moved_f32=bytes_f32,
+                sign_bits=bits, d=d,
+            )
+
+    packed_ratio = hbm_lib.packed_stack_bytes(k, d) / hbm_lib.stack_bytes(k, d)
+    emit({
+        "metric": "signpack_summary", "platform": backend, "k": k, "d": d,
+        "parity_ok": worst_ok,
+        "pallas_vmem_ok": pk.supports_signpack_fused(k),
+        "pallas_vmem_reason": pk.signpack_fused_reason(k),
+        "packed_stack_ratio": round(packed_ratio, 4),
+        # the acceptance bar the perf gate re-checks from the ledger rows
+        "packed_within_1_24": packed_ratio <= 1.0 / 24.0,
+        "speedup_vs_f32": {
+            impl: round(timing["f32_vote"] / ms, 2)
+            for impl, ms in timing.items()
+            if impl != "f32_vote" and ms
+        },
+    })
+    return 0 if worst_ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--k", type=int, default=1000)
@@ -102,6 +224,13 @@ def main(argv=None) -> int:
         "--ledger", default=None,
         help="append the timed epilogue rows to this perf ledger "
              "(obs/ledger.py; gate with analysis/perf_gate.py)",
+    )
+    ap.add_argument(
+        "--signpack", action="store_true",
+        help="bench the packed one-bit sign reduce instead: popcount "
+             "kernel (pallas + XLA bit-plane) vs the unpacked f32 sign "
+             "vote, emitting bytes_moved columns from the obs/hbm.py "
+             "packed model next to wall clock",
     )
     args = ap.parse_args(argv)
 
@@ -128,6 +257,11 @@ def main(argv=None) -> int:
 
     def emit(row):
         sink.emit(obs_lib.make_event("bench", **row))
+
+    if args.signpack:
+        rc = _signpack_bench(args, emit, w, w_adv, backend, on_tpu)
+        sink.close()
+        return rc
 
     def sort_path(agg, mat, oma=False):
         if oma:

@@ -1,0 +1,412 @@
+"""Accuracy-vs-bits matrix: the sign-channel payload widths
+(``--sign-bits`` 1/8/16/32) against every registered attack — the static
+stack-level tier AND the defense-aware adaptive tier.
+
+The break-matrix (:mod:`.adaptive_matrix`) asks "does the defense notice";
+this tool asks the question the one-bit OTA tentpole raises: when the
+sign channel narrows from full-precision ballots to the bit-packed wire
+(~32x less traffic, :mod:`..ops.aggregators` ``pack_signs``), what does
+each attack's damage do?  In particular: does ``under_radar`` — the
+attack built to stay under the detector's z-threshold — get EASIER or
+HARDER at one bit?  Cells run the real vote aggregators and the real
+``defense/`` scoring on a small synthetic quadratic descent (the
+``adaptive_matrix`` regime: a tight honest cluster one SGD step from the
+params), so the whole matrix is seconds, not training runs:
+
+    python -m byzantine_aircomp_tpu.analysis.bits_matrix \\
+        --bits 1,8,16,32 --iters 40 --json docs/bits_matrix.json
+
+Semantics mirrored from the trainer (fed/train.py):
+
+* honest clients descend a fixed quadratic (``0.5 * |x - target|^2``)
+  with per-client gradient noise; the cell metric is the final distance
+  to the optimum (``final_dist`` — the accuracy proxy) plus the
+  per-iteration fraction of coordinates whose voted step DIFFERS from
+  the 32-bit vote on the SAME stack (``flip_frac`` — what narrowing
+  alone changes);
+* a monitor-mode detector runs alongside every cell so the defense-aware
+  attacks observe the PREVIOUS iteration's published state
+  (:class:`..ops.attacks.DefenseView`), exactly the trainer's ordering;
+* ``duty_cycle`` schedules itself off the policy constants and stretches
+  the horizon to two full burst/sleep periods;
+* data-level attacks with no gradient-scale emulation never touch the
+  transmitted stack — ``skipped``, as in the break-matrix.
+
+Output: one JSON line per cell on stdout (kind ``bits_cell``), markdown
+tables on stderr, optionally a canonical timestamp-free JSON dump
+(``--json``) and markdown file (``--md``) whose bytes are a pure
+function of the flags + ``--seed`` — ``docs/bits_matrix.json`` /
+``docs/bits_matrix.md`` are committed from the default invocation.
+``--assert-smoke`` turns the matrix into a CI gate: every requested cell
+must be finite and each attack's 1-bit ``final_dist`` must stay within
+``SMOKE_TOL_FACTOR`` of its 32-bit cell (plus the ``SMOKE_TOL_ABS``
+noise floor) — one-bit narrowing may cost accuracy, but it must not
+hand any attack an order-of-magnitude win.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import defense as defense_lib
+from .. import obs as obs_lib
+from ..ops import aggregators as agg_lib
+from ..ops import attacks as attack_lib
+from ..registry import ATTACKS
+
+K, B, D = 16, 3, 24
+HONEST = K - B
+
+BITS = (1, 8, 16, 32)
+
+#: the --assert-smoke tolerance: a 1-bit cell may lose ground to its
+#: 32-bit sibling (row-level non-finite masking, zero-delta rounding,
+#: quantized ballots) but within this factor + absolute floor.  The floor
+#: absorbs cells that converge to ~the sign_eta deadband, where ratios of
+#: two near-zero distances are noise.
+SMOKE_TOL_FACTOR = 2.5
+SMOKE_TOL_ABS = 0.5
+
+Cell = Tuple[str, int]  # (attack, sign_bits)
+
+
+def _attacked(spec, w, base, key, defense=None):
+    """The transmitted stack under ``spec`` (the break-matrix helper):
+    the message attack where it acts, else the gradient-scale emulation."""
+    w_att = spec.apply_message(w, B, key, defense=defense)
+    if spec.grad_scale != 1.0 and bool(jnp.all(w_att == w)):
+        dev = w[-B:] - base[None, :]
+        w_att = w.at[-B:].set(base[None, :] + spec.grad_scale * dev)
+    return w_att
+
+
+def _skip(reason: str) -> Dict[str, object]:
+    return {"skipped": reason}
+
+
+def simulate_cell(
+    attack_name: str,
+    bits: int,
+    *,
+    agg: str = "signmv",
+    iters: int = 40,
+    sign_eta: float = 0.05,
+    seed: int = 0,
+    det: Optional[defense_lib.DetectorParams] = None,
+    pol: Optional[defense_lib.PolicyParams] = None,
+) -> Dict[str, object]:
+    """One (attack, sign_bits) cell: ``iters`` eager vote-descent steps
+    on the synthetic quadratic with the attack active throughout.
+
+    Reports the accuracy proxies (``final_dist`` / ``best_dist`` to the
+    optimum, ``final_honest_dist`` to the honest mean of the last stack),
+    the channel-narrowing signature (``flip_frac``: mean fraction of
+    coordinates per iteration whose voted step differs from the 32-bit
+    vote on the same stack; 0.0 by construction at bits=32), and the
+    detection columns of the monitor detector running alongside
+    (``detect_iter`` relative to iteration 0, ``recall`` over the B known
+    attacker rows, ``rounds_suspicious``)."""
+    spec = attack_lib.resolve(attack_name)
+    meta = spec.meta()
+    if meta["data_level"] and spec.grad_scale == 1.0:
+        return _skip(
+            "data-level attack leaves the transmitted stack untouched "
+            "(no stack-level signature exists; see fault/attack tiers "
+            "in DESIGN.md)"
+        )
+    det = det or defense_lib.DetectorParams()
+    pol = pol or defense_lib.PolicyParams(
+        up_n=3, down_m=8, n_rungs=3, min_flagged=2
+    )
+    if attack_name.split("@")[0] == "duty_cycle":
+        on_p, period = attack_lib.duty_cycle_schedule(pol)
+        iters = max(iters, 2 * period + on_p)
+    agg_fn = (
+        agg_lib.sign_majority_vote if agg == "signmv"
+        else agg_lib.best_effort_voting
+    )
+    key0 = jax.random.PRNGKey(seed)
+    target = 0.5 * jax.random.normal(jax.random.fold_in(key0, 3), (D,))
+    target = target.astype(jnp.float32)
+    x = jnp.zeros((D,), jnp.float32)
+    d_state = defense_lib.init_detector(K)
+    p_state = defense_lib.init_policy()
+    detect_iter = None
+    detected_rows: set = set()
+    rounds_susp = 0
+    best_dist = float(jnp.linalg.norm(x - target))
+    flip_sum = 0.0
+    w = x[None, :]
+    for t in range(iters):
+        kt = jax.random.fold_in(key0, 100 + t)
+        grad = (x - target)[None, :] + 0.1 * jax.random.normal(
+            kt, (K, D), jnp.float32
+        )
+        w = (x[None, :] - 0.05 * grad).astype(jnp.float32)
+        d_view = None
+        if meta["defense_aware"]:
+            # trainer semantics: the attack observes the PREVIOUS
+            # iteration's published state (it runs pre-update)
+            d_view = attack_lib.DefenseView(
+                step=d_state[0], ema=d_state[1], dev=d_state[2],
+                cusum=d_state[3], rung=p_state[0],
+                detector=det, policy=pol, guess=x,
+            )
+        w = _attacked(
+            spec, w, x, jax.random.fold_in(key0, 200 + t), defense=d_view
+        )
+        x_new = agg_fn(w, guess=x, sign_eta=sign_eta, sign_bits=bits)
+        if bits != 32:
+            x_ref = agg_fn(w, guess=x, sign_eta=sign_eta)
+            flip_sum += float(
+                jnp.mean(jnp.sign(x_new - x) != jnp.sign(x_ref - x))
+            )
+        # monitor detector alongside (publishes the state the
+        # defense-aware tier observes; never alters the aggregate)
+        score, finite = defense_lib.client_scores(w, x)
+        d_state, flags = defense_lib.detector_update(
+            d_state, score, finite, det
+        )
+        p_state, susp = defense_lib.policy_update(
+            p_state, jnp.sum(flags), pol
+        )
+        rounds_susp += int(bool(susp))
+        if detect_iter is None and int(jnp.sum(flags)) > 0:
+            detect_iter = t
+        detected_rows.update(
+            K - B + i for i in range(B) if bool(flags[K - B + i])
+        )
+        x = x_new
+        best_dist = min(best_dist, float(jnp.linalg.norm(x - target)))
+    return {
+        "final_dist": round(float(jnp.linalg.norm(x - target)), 5),
+        "best_dist": round(best_dist, 5),
+        "final_honest_dist": round(
+            float(jnp.linalg.norm(x - jnp.mean(w[:HONEST], axis=0))), 5
+        ),
+        "flip_frac": round(flip_sum / iters, 5),
+        "detect_iter": detect_iter,
+        "recall": round(len(detected_rows) / B, 5),
+        "rounds_suspicious": rounds_susp,
+    }
+
+
+def run_matrix(
+    attacks: List[str],
+    bits_list: List[int],
+    log=lambda s: print(s, file=sys.stderr, flush=True),
+    on_cell=None,
+    **sim_kw,
+) -> Dict[Cell, Dict[str, object]]:
+    for a in attacks:
+        attack_lib.resolve(a)  # fail fast on typos
+    for b in bits_list:
+        if b not in BITS:
+            raise ValueError(f"unknown sign_bits {b}; pick from {BITS}")
+    grid: Dict[Cell, Dict[str, object]] = {}
+    for attack in attacks:
+        for bits in bits_list:
+            cell = simulate_cell(attack, bits, **sim_kw)
+            grid[(attack, bits)] = cell
+            log(f"[bits_matrix] attack={attack} bits={bits}: {cell}")
+            if on_cell is not None:
+                on_cell(attack, bits, cell)
+    return grid
+
+
+def markdown_table(grid: Dict[Cell, Dict[str, object]]) -> str:
+    """One ``attack x bits`` block per metric family: the accuracy proxy
+    (``final_dist``), the narrowing signature (``flip_frac``), and
+    detection latency.  Skipped cells say so; undetected cells show
+    ``-`` so a silent attack can't read as instant."""
+    attacks = sorted({a for a, _ in grid})
+    bits_list = sorted({b for _, b in grid})
+    head_bits = " | ".join(f"{b}b" for b in bits_list)
+    blocks = []
+    for metric, fmt in (
+        ("final_dist", lambda c: f"{c['final_dist']:.3f}"),
+        ("flip_frac", lambda c: f"{c['flip_frac']:.3f}"),
+        ("detect_iter", lambda c: (
+            "-" if c["detect_iter"] is None else str(c["detect_iter"])
+        )),
+    ):
+        rows = [f"**{metric} by sign_bits**\n\n| attack | {head_bits} |",
+                "|---|" + "---|" * len(bits_list)]
+        for a in attacks:
+            cells = []
+            for b in bits_list:
+                c = grid[(a, b)]
+                cells.append("skipped" if "skipped" in c else fmt(c))
+            rows.append(f"| {a} | " + " | ".join(cells) + " |")
+        blocks.append("\n".join(rows))
+    return "\n\n".join(blocks)
+
+
+def under_radar_verdict(
+    grid: Dict[Cell, Dict[str, object]]
+) -> Optional[Dict[str, object]]:
+    """The question the matrix exists to answer: is ``under_radar``
+    easier or harder at one bit?  Compares its 1-bit vs 32-bit damage
+    (final_dist) and detection latency; ``harder`` means the packed wire
+    did NOT hand the evasion attack extra damage."""
+    lo = grid.get(("under_radar", 1))
+    hi = grid.get(("under_radar", 32))
+    if not lo or not hi or "skipped" in lo or "skipped" in hi:
+        return None
+    ratio = (
+        lo["final_dist"] / hi["final_dist"] if hi["final_dist"] > 0
+        else float("inf")
+    )
+    return {
+        "final_dist_1b": lo["final_dist"],
+        "final_dist_32b": hi["final_dist"],
+        "damage_ratio_1b_over_32b": round(ratio, 4),
+        "detect_iter_1b": lo["detect_iter"],
+        "detect_iter_32b": hi["detect_iter"],
+        "verdict": (
+            "harder_or_equal_at_1_bit" if ratio <= 1.0 + 1e-9
+            else "easier_at_1_bit"
+        ),
+    }
+
+
+def assert_smoke(grid: Dict[Cell, Dict[str, object]]) -> None:
+    """The CI acceptance gate (``--assert-smoke``): every non-skipped
+    cell finite, and each attack's 1-bit final_dist within
+    ``SMOKE_TOL_FACTOR`` x its 32-bit cell + ``SMOKE_TOL_ABS``."""
+    import math
+
+    ran = {k: c for k, c in grid.items() if "skipped" not in c}
+    if not ran:
+        raise SystemExit("[bits_matrix] smoke: every cell was skipped")
+    for k, c in ran.items():
+        if not all(
+            math.isfinite(c[f]) for f in ("final_dist", "best_dist",
+                                          "flip_frac")
+        ):
+            raise SystemExit(
+                f"[bits_matrix] smoke: non-finite cell {k}: {c}"
+            )
+    attacks = sorted({a for a, _ in ran})
+    bits_ran = {b for _, b in ran}
+    if not {1, 32} <= bits_ran:
+        raise SystemExit(
+            "[bits_matrix] smoke: needs both the 1-bit and 32-bit "
+            f"columns to compare (ran {sorted(bits_ran)})"
+        )
+    for a in attacks:
+        lo, hi = ran.get((a, 1)), ran.get((a, 32))
+        if lo is None or hi is None:
+            continue
+        bound = SMOKE_TOL_FACTOR * hi["final_dist"] + SMOKE_TOL_ABS
+        if lo["final_dist"] > bound:
+            raise SystemExit(
+                f"[bits_matrix] smoke: {a} at 1 bit lands at "
+                f"final_dist {lo['final_dist']} vs {hi['final_dist']} "
+                f"at 32 bits — over the {SMOKE_TOL_FACTOR}x + "
+                f"{SMOKE_TOL_ABS} tolerance ({bound:.3f}); the packed "
+                "wire handed this attack a win"
+            )
+    print("[bits_matrix] smoke assertions passed", file=sys.stderr)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--attacks", default=None,
+                    help="comma list; default: every registered attack")
+    ap.add_argument("--bits", default="1,8,16,32",
+                    help="comma list of sign-channel widths")
+    ap.add_argument("--agg", default="signmv", choices=["signmv", "bev"],
+                    help="which vote aggregator carries the channel")
+    ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--sign-eta", type=float, default=0.05)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="PRNG seed for every cell; cells are a pure "
+                         "function of (flags, seed) for cross-PR diffing")
+    ap.add_argument("--json", default=None,
+                    help="canonical sorted timestamp-free JSON dump here "
+                         "(committed artifacts diff cleanly)")
+    ap.add_argument("--md", default=None,
+                    help="also write the markdown tables here")
+    ap.add_argument("--obs-dir", default=None,
+                    help="also append bits_cell events (JSONL) here")
+    ap.add_argument("--assert-smoke", action="store_true",
+                    help="exit nonzero unless every cell is finite and "
+                         "the 1-bit column stays within tolerance of "
+                         "32 bits")
+    args = ap.parse_args(argv)
+
+    attacks = (
+        [a for a in args.attacks.split(",") if a]
+        if args.attacks
+        else sorted(ATTACKS.names())
+    )
+    bits_list = [int(b) for b in args.bits.split(",") if b]
+    sinks = [obs_lib.StdoutSink()]
+    if args.obs_dir:
+        sinks.append(
+            obs_lib.JsonlSink(
+                obs_lib.events_path(args.obs_dir, "bits_matrix")
+            )
+        )
+    sink = obs_lib.MultiSink(sinks) if len(sinks) > 1 else sinks[0]
+    try:
+        grid = run_matrix(
+            attacks,
+            bits_list,
+            agg=args.agg,
+            iters=args.iters,
+            sign_eta=args.sign_eta,
+            seed=args.seed,
+            on_cell=lambda attack, bits, cell: sink.emit(
+                obs_lib.make_event(
+                    "bits_cell", attack=attack, sign_bits=bits,
+                    agg=args.agg, **cell
+                )
+            ),
+        )
+    finally:
+        sink.close()
+    table = markdown_table(grid)
+    print(table, file=sys.stderr, flush=True)
+    verdict = under_radar_verdict(grid)
+    if verdict is not None:
+        print(f"[bits_matrix] under_radar: {verdict}", file=sys.stderr)
+    if args.json:
+        dump = {f"{a}|{b}": c for (a, b), c in grid.items()}
+        if verdict is not None:
+            dump["_under_radar"] = verdict
+        with open(args.json, "w") as f:
+            json.dump(dump, f, sort_keys=True, indent=1)
+            f.write("\n")
+        print(f"[bits_matrix] grid dumped to {args.json}", file=sys.stderr)
+    if args.md:
+        with open(args.md, "w") as f:
+            f.write(
+                "# Accuracy vs sign-channel width (bits_matrix)\n\n"
+                f"`python -m byzantine_aircomp_tpu.analysis.bits_matrix "
+                f"--agg {args.agg} --iters {args.iters} --seed "
+                f"{args.seed}`\n\n"
+            )
+            f.write(table + "\n")
+            if verdict is not None:
+                f.write(
+                    f"\n**under_radar at one bit:** `{verdict['verdict']}`"
+                    f" (damage ratio {verdict['damage_ratio_1b_over_32b']}"
+                    f"x, detection {verdict['detect_iter_1b']} vs "
+                    f"{verdict['detect_iter_32b']})\n"
+                )
+        print(f"[bits_matrix] markdown written to {args.md}",
+              file=sys.stderr)
+    if args.assert_smoke:
+        assert_smoke(grid)
+
+
+if __name__ == "__main__":
+    main()

@@ -114,7 +114,7 @@ def gate(
             "expected_platform": expect_platform,
             "fallback_reason": row.get("fallback_reason") or row.get("error"),
         }
-    return ledger.compare(
+    verdict = ledger.compare(
         str(row["metric"]),
         float(row["value"]),
         platform=platform,
@@ -123,6 +123,16 @@ def gate(
         rel_tol=rel_tol,
         mad_sigmas=mad_sigmas,
     )
+    # bandwidth rows (the packed sign channel): surface the modeled
+    # bytes_moved ratio in the verdict so the ~32x claim is in the gate
+    # output, not just a JSON field nobody reads
+    if row.get("bytes_moved") is not None and row.get("bytes_moved_f32"):
+        verdict["bytes_moved"] = row["bytes_moved"]
+        verdict["bytes_moved_f32"] = row["bytes_moved_f32"]
+        verdict["bytes_ratio"] = round(
+            row["bytes_moved"] / row["bytes_moved_f32"], 4
+        )
+    return verdict
 
 
 def _exit_code(verdict: str, strict_platform: bool) -> int:
@@ -277,6 +287,11 @@ def main(argv=None) -> int:
                 + (f"; fallback: {verdict['fallback_reason']}"
                    if verdict.get("fallback_reason") else "")
                 + ")"
+            )
+        if verdict.get("bytes_ratio") is not None:
+            detail += (
+                f" [bytes_moved {verdict['bytes_moved']} vs f32 "
+                f"{verdict['bytes_moved_f32']} = {verdict['bytes_ratio']}x]"
             )
         print(
             f"[perf_gate] {verdict['verdict']}: {verdict.get('metric')} = "

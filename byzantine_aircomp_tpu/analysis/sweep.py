@@ -266,6 +266,7 @@ def main(argv=None) -> None:
         clip_tau=args.clip_tau,
         clip_iters=args.clip_iters,
         sign_eta=args.sign_eta,
+        sign_bits=args.sign_bits,
         dnc_iters=args.dnc_iters,
         dnc_sub_dim=args.dnc_sub_dim,
         dnc_c=args.dnc_c,

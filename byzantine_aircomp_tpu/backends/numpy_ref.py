@@ -326,6 +326,61 @@ def sign_majority_vote(
     return (guess + eta * np.sign(votes)).astype(np.float32)
 
 
+# ---------------------------------------------------------------------------
+# packed one-bit sign wire (sign_bits=1) — oracles for the jax pipeline in
+# ops/aggregators.py (pack_signs / packed_sign_votes) and the pallas
+# popcount kernel.  Wire format: [K, W = ceil(d/32)] uint32, LSB-first
+# (coordinate c at bit c % 32 of word c // 32); bit 1 = ballot +1
+# (delta >= 0, +0.0 votes +1), bit 0 = ballot -1; a row with ANY
+# non-finite coordinate packs all-zero words and leaves k_valid, so it
+# casts zero ballots in both the packed and (row-coarsened) unpacked vote.
+
+
+def pack_signs(w: np.ndarray, guess: np.ndarray):
+    """Oracle packer: ``(words [K, ceil(d/32)] uint32, k_valid int)``."""
+    delta = np.asarray(w, np.float32) - np.asarray(guess, np.float32)[None, :]
+    finite = np.isfinite(delta).all(axis=1)
+    k, d = delta.shape
+    w_cnt = -(-d // 32)
+    bits = np.zeros((k, w_cnt * 32), np.uint32)
+    bits[:, :d] = (delta >= 0) & finite[:, None]
+    weights = (np.uint32(1) << np.arange(32, dtype=np.uint32))
+    words = (bits.reshape(k, w_cnt, 32) * weights).sum(
+        axis=-1, dtype=np.uint64
+    ).astype(np.uint32)
+    return words, int(finite.sum())
+
+
+def packed_vote_counts(words: np.ndarray, d: int) -> np.ndarray:
+    """Oracle popcount reduce: per-coordinate set-bit counts [d] int64."""
+    planes = (
+        words[:, :, None] >> np.arange(32, dtype=np.uint32)[None, None, :]
+    ) & np.uint32(1)
+    return planes.sum(axis=0).reshape(-1)[:d].astype(np.int64)
+
+
+def packed_sign_step(
+    w: np.ndarray,
+    guess: np.ndarray,
+    sign_eta: float,
+    noise: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Oracle for the sign_bits=1 signmv/bev step: pack, popcount, recover
+    the signed ballot sum as ``2*counts - k_valid`` (each set bit +1, each
+    clear bit of a valid row -1), step ``sign_eta`` in the voted
+    direction.  ``noise`` is the receiver AWGN draw for signmv (bev, a
+    receiver-side rung, passes None)."""
+    words, k_valid = pack_signs(w, guess)
+    counts = packed_vote_counts(words, w.shape[1])
+    votes = (2 * counts - k_valid).astype(np.float64)
+    if noise is not None:
+        votes = votes + noise
+    return (
+        np.asarray(guess, np.float32)
+        + np.float32(sign_eta) * np.sign(votes).astype(np.float32)
+    )
+
+
 def centered_clip(
     w: np.ndarray,
     guess: Optional[np.ndarray] = None,

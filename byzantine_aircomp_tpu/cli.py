@@ -78,6 +78,12 @@ def add_knob_flags(p) -> None:
     p.add_argument("--sign-eta", type=float, default=None,
                    help="one-bit OTA majority-vote step size (agg=signmv; "
                         "default: coordinatewise median delta magnitude)")
+    p.add_argument("--sign-bits", type=int, choices=[1, 8, 16, 32],
+                   default=32,
+                   help="sign-channel payload width (agg=signmv/bev): 32 = "
+                        "legacy f32 ballots, 1 = bit-packed uint32 words + "
+                        "popcount reduce (needs --sign-eta), 8/16 = "
+                        "quantize-dequantize emulation")
     p.add_argument("--dnc-iters", type=int, default=3,
                    help="dnc filtering rounds (agg=dnc)")
     p.add_argument("--dnc-sub-dim", type=int, default=10000,
@@ -204,6 +210,7 @@ ARG_TO_FIELD = {
     "clip_tau": ("clip_tau", None),
     "clip_iters": ("clip_iters", None),
     "sign_eta": ("sign_eta", None),
+    "sign_bits": ("sign_bits", None),
     "dnc_iters": ("dnc_iters", None),
     "dnc_sub_dim": ("dnc_sub_dim", None),
     "dnc_c": ("dnc_c", None),

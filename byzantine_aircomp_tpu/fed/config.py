@@ -131,6 +131,15 @@ class FedConfig:
     # signmv (one-bit OTA majority vote) step magnitude; None = the
     # coordinatewise median of |w_i - guess| (robust adaptive scale)
     sign_eta: Optional[float] = None
+    # sign-channel payload width for the vote aggregators (signmv/bev):
+    # 32 = legacy full-precision ballots (byte-identical trajectories);
+    # 1 = bit-packed uint32 sign words + popcount reduce (the one-bit OTA
+    # wire, ~32x less sign-stack HBM/air traffic — needs an explicit
+    # sign_eta since the wire carries no magnitudes); 8/16 =
+    # quantize-dequantize emulation for the accuracy-vs-bits matrix.
+    # Structural and hashed (skipped at the 32 default for checkpoint-
+    # title continuity, like the defense/cohort/service knob blocks)
+    sign_bits: int = 32
     # dnc (spectral divide-and-conquer) knobs — the paper's defaults:
     # filtering rounds, coordinate-subsample size, removal multiplier
     # (ceil(c*B) flagged per round)
@@ -514,6 +523,34 @@ class FedConfig:
         assert self.sign_eta is None or self.sign_eta > 0, (
             f"sign_eta must be positive when set, got {self.sign_eta}"
         )
+        if self.sign_bits not in (1, 8, 16, 32):
+            raise ValueError(
+                f"sign_bits must be one of 1, 8, 16, 32 "
+                f"(payload width of the sign channel), got {self.sign_bits}"
+            )
+        if self.sign_bits != 32:
+            if self.agg not in ("signmv", "bev"):
+                raise ValueError(
+                    f"sign_bits={self.sign_bits} narrows the SIGN channel "
+                    f"— only the vote aggregators transmit it; "
+                    f"agg={self.agg!r} transmits full-precision weights "
+                    f"(use --agg signmv or bev, or leave sign_bits at 32)"
+                )
+        if self.sign_bits == 1:
+            if self.bucket_size != 1:
+                raise ValueError(
+                    "sign_bits=1 packs each client's ballots into uint32 "
+                    "words — bucket means over packed words are undefined "
+                    "(a mean of sign words is not a sign word); use "
+                    "--bucket-size 1"
+                )
+            if self.sign_eta is None:
+                raise ValueError(
+                    "sign_bits=1 requires an explicit --sign-eta: the "
+                    "one-bit wire carries no delta magnitudes, so the "
+                    "adaptive eta (coordinatewise median of |delta|) has "
+                    "nothing to estimate from"
+                )
         assert (
             self.dnc_iters >= 1 and self.dnc_sub_dim >= 1 and self.dnc_c > 0
         ), (

@@ -119,6 +119,8 @@ def run_title(cfg: FedConfig) -> str:
         title += f"_ci{cfg.clip_iters}"
     if cfg.sign_eta is not None:
         title += f"_eta{cfg.sign_eta}"
+    if _non_default(cfg, "sign_bits"):
+        title += f"_sb{cfg.sign_bits}"
     if _non_default(cfg, "dnc_iters"):
         title += f"_di{cfg.dnc_iters}"
     if _non_default(cfg, "dnc_sub_dim"):
@@ -231,6 +233,11 @@ def config_hash(cfg: FedConfig) -> str:
         # must hash identically to builds that predate them (validate()
         # pins every service knob to its default when service is off)
         skip = skip + ("service",) + FedConfig._SERVICE_KNOBS
+    if cfg.sign_bits == 32:
+        # same continuity contract: a full-width (legacy) sign channel
+        # must hash identically to builds that predate the sign_bits
+        # field — the 32 default is byte-identical to the old path
+        skip = skip + ("sign_bits",)
     items = sorted(
         (f.name, repr(getattr(cfg, f.name)))
         for f in dataclasses.fields(cfg)

@@ -228,6 +228,26 @@ class FedTrainer:
             # consumes the same standalone channel prepass instead
             self._fused_epilogue = False
 
+        # packed one-bit sign channel (ops/aggregators.pack_signs): the
+        # trainer pre-packs the [K, W] uint32 sign words in the aggregate
+        # scope so XLA fuses the pack into the stack read and the f32
+        # [K, d] sign stack never materializes in HBM.  Same residency
+        # contract as _fused_epilogue: one statically-known vote consumer,
+        # resident stack, no fault/service degradation.  An adaptive
+        # defense switches rungs dynamically, so the aggregators pack
+        # internally there instead (packed=None, sign_bits still 1) —
+        # correct, just without the fused-pack guarantee
+        self._sign_packed = (
+            cfg.sign_bits == 1
+            and cfg.agg in ("signmv", "bev")
+            and cfg.bucket_size == 1
+            and self.fault is None
+            and cfg.service == "off"
+            and not (
+                self.defense is not None and self.defense.mode == "adaptive"
+            )
+        )
+
         # server optimizer over the pseudo-gradient (FedAvgM / FedAdam);
         # "none" = take the aggregate directly (reference :354-358)
         if cfg.server_opt == "momentum":
@@ -480,6 +500,10 @@ class FedTrainer:
             clip_tau=cfg.clip_tau,
             clip_iters=cfg.clip_iters,
             sign_eta=cfg.sign_eta,
+            # a bev rung packs internally (no pre-packed words here: the
+            # pack belongs to exactly ONE statically-known consumer, and
+            # an adaptive rung is not static — same rule as oma_key)
+            sign_bits=cfg.sign_bits,
             dnc_iters=cfg.dnc_iters,
             dnc_sub_dim=cfg.dnc_sub_dim,
             dnc_c=cfg.dnc_c,
@@ -916,6 +940,15 @@ class FedTrainer:
             # arithmetic stays f32 via promotion / in-kernel upcast, and
             # the aggregate is cast back so the params carry stays f32
             w_agg = w_for_agg.astype(self._stack_dtype)
+            # packed one-bit wire: pack the sign words HERE, adjacent to
+            # the stack read, so XLA fuses the elementwise sign/shift
+            # chain into the stack producer — the f32 [K, d] sign stack
+            # never exists in HBM on this path (gate doc in __init__)
+            packed = (
+                agg_lib.pack_signs(w_agg, flat_params)
+                if self._sign_packed
+                else None
+            )
             # service rounds: the rollback-widened trim fraction rides the
             # carry as a traced scalar — only the degraded trimmed_mean
             # path (dynamic trim budget) consumes it; every other
@@ -957,6 +990,10 @@ class FedTrainer:
                     clip_tau=cfg.clip_tau,
                     clip_iters=cfg.clip_iters,
                     sign_eta=cfg.sign_eta,
+                    # packed one-bit sign channel (pack_signs above);
+                    # sign_bits=32 is the legacy byte-identical path
+                    sign_bits=cfg.sign_bits,
+                    packed=packed,
                     dnc_iters=cfg.dnc_iters,
                     dnc_sub_dim=cfg.dnc_sub_dim,
                     dnc_c=cfg.dnc_c,

@@ -28,6 +28,41 @@ def stack_bytes(k: int, d: int, dtype_bytes: int = 4) -> int:
     return k * d * dtype_bytes
 
 
+def packed_stack_bytes(k: int, d: int, bits: int = 1) -> int:
+    """Bytes of the packed sign-channel payload for a [K, d] delta stack.
+
+    ``bits=1`` is the bit-packed uint32 wire (``ops.aggregators
+    .pack_signs``): K rows of ``ceil(d/32)`` whole words — ~1/32 of the
+    f32 stack, the acceptance-gated ratio.  ``bits=8/16`` model the
+    quantize-dequantize emulation's hypothetical wire (``k*d*bits/8``,
+    exact since bytes need no word padding); ``bits=32`` degenerates to
+    :func:`stack_bytes`."""
+    if bits == 1:
+        return k * (-(-d // 32)) * 4
+    return k * d * bits // 8
+
+
+def packed_vote_hbm_bytes(k: int, d: int, impl: str = "pallas") -> int:
+    """Analytic HBM bytes of one packed majority-vote reduce.
+
+    Both realizations read the [K, W] uint32 words exactly once.  The
+    pallas kernel stores a [32, Wp] int32 counts tile per word column and
+    the caller's transpose fix-up re-reads/writes it in coordinate order
+    (O(d), counted honestly — it is ~K/32 times smaller than the word
+    read); the XLA bit-plane fallback materializes the same [W, 32]
+    counts.  Compare against ``stack_bytes(k, d) * 34`` (the f32 select
+    reduce) or the 3-pass sort lower bound for the bandwidth table."""
+    w_cnt = -(-d // 32)
+    words = k * w_cnt * 4
+    counts = 32 * w_cnt * 4  # [32, W] int32 counts (write + fix-up read)
+    out = d * 4
+    if impl == "pallas":
+        kp, wp = -(-k // 8) * 8, -(-w_cnt // 128) * 128
+        words = kp * wp * 4  # padded word tiles, DMA'd into VMEM once
+        counts = 32 * wp * 4
+    return words + 2 * counts + out
+
+
 def epilogue_hbm_bytes(
     impl: str, k: int, d: int, b: int, channel: bool
 ) -> int:

@@ -54,6 +54,13 @@ LEDGER_EXTRA_FIELDS = (
     "peak_source",
     "peak_streamed_modeled_bytes",
     "peak_resident_modeled_bytes",
+    # packed one-bit sign channel (ops/aggregators.pack_signs): modeled
+    # wire/reduce traffic of the row's realization vs the f32 baseline it
+    # replaces, and the payload width that produced it — the columns the
+    # ~32x bandwidth acceptance gate reads (analysis/perf_gate.py)
+    "bytes_moved",
+    "bytes_moved_f32",
+    "sign_bits",
 )
 
 #: relative band half-width tolerated as noise (±10%)

@@ -595,10 +595,154 @@ def dnc(
     return jnp.where(count > 0, mean_kept, _finite_centroid(wmatrix, finite))
 
 
+# ---------------------------------------------------------------------------
+# packed one-bit sign channel (signmv / bev ballots)
+#
+# The sign aggregators' wire payload is ONE ballot per coordinate, but the
+# unpacked path still moves it as f32 lanes — the 32x bandwidth win that
+# motivates one-bit OTA is unrealized.  The helpers below define the packed
+# wire format and its two reduce realizations:
+#
+# * wire format: [K, W = ceil(d/32)] uint32 words, LSB-first — coordinate
+#   ``c`` lives at bit ``c % 32`` of word ``c // 32``.  Bit 1 = ballot +1
+#   (delta >= 0, i.e. the IEEE sign bit of the delta with +0.0 voting +1);
+#   bit 0 = ballot -1.  A row with ANY non-finite coordinate is invalid:
+#   its words are packed all-zero and it is excluded from ``k_valid``, so
+#   it casts zero ballots — the unpacked vote's 0-ballot rule for
+#   non-finite deltas, coarsened to row granularity (DESIGN.md).
+# * reduce: per-coordinate set-bit counts over K; the signed ballot sum is
+#   recovered as ``votes = 2*counts - k_valid`` (each set bit is +1, each
+#   clear bit of a valid row is -1).  Integer counts, so the Pallas kernel
+#   and the XLA bit-plane fallback are bit-identical by construction.
+#
+# One-bit is the ONLY packed width: an exact 3-state {-1, 0, +1} encoding
+# needs >= log2(3) bits/coordinate and cannot reach the 32x bar, so zero
+# deltas round up to +1 on the packed wire (sign_bits=1) while the
+# unpacked paths keep sign(0) = 0.  Tests pin the convention; trajectories
+# over real float deltas (no exact ties against the previous params) are
+# unaffected.
+
+SIGNPACK_WORD_BITS = pallas_kernels.SIGNPACK_BITS  # 32, LSB-first
+
+
+def packed_words(d: int) -> int:
+    """uint32 sign words per client for a d-coordinate delta."""
+    return -(-d // SIGNPACK_WORD_BITS)
+
+
+def pack_signs(wmatrix: jnp.ndarray, guess: jnp.ndarray):
+    """[K, d] stack + pre-round params -> ``(words [K, W] uint32, k_valid)``.
+
+    Pure elementwise + lane reduce over the stack read, so on the trainer's
+    resident path XLA fuses it into the stack producer and the f32 sign
+    stack never exists in HBM — the packed words ARE the materialization.
+    ``k_valid`` (int32 scalar) counts the all-finite rows; invalid rows
+    are packed all-zero (zero ballots, see the wire-format comment)."""
+    k, d = wmatrix.shape
+    w_cnt = packed_words(d)
+    delta = wmatrix.astype(jnp.float32) - guess[None, :].astype(jnp.float32)
+    finite = _finite_rows(delta)  # [K]
+    ballot_up = jnp.logical_and(finite[:, None], delta >= 0.0)  # [K, d]
+    pad = w_cnt * SIGNPACK_WORD_BITS - d
+    bits = jnp.pad(ballot_up, ((0, 0), (0, pad))).reshape(
+        k, w_cnt, SIGNPACK_WORD_BITS
+    )
+    weights = jnp.uint32(1) << jnp.arange(
+        SIGNPACK_WORD_BITS, dtype=jnp.uint32
+    )
+    words = jnp.sum(
+        jnp.where(bits, weights[None, None, :], jnp.uint32(0)), axis=-1
+    )
+    return words, jnp.sum(finite).astype(jnp.int32)
+
+
+def _packed_vote_counts_xla(words: jnp.ndarray, d: int) -> jnp.ndarray:
+    """XLA bit-plane realization of the packed reduce: counts [d] int32.
+
+    ``[K, W] >> j & 1`` for j in [0, 32) -> [K, W, 32] bit planes, summed
+    over K to [W, 32]; the row-major flatten is exactly the LSB-first
+    coordinate order ``c = w*32 + j``.  Integer arithmetic throughout, so
+    bit-identical to ``pallas_kernels.packed_vote_counts``."""
+    shifts = jnp.arange(SIGNPACK_WORD_BITS, dtype=jnp.uint32)
+    planes = (words[:, :, None] >> shifts[None, None, :]) & jnp.uint32(1)
+    counts = jnp.sum(planes.astype(jnp.int32), axis=0)  # [W, 32]
+    return counts.reshape(-1)[:d]
+
+
+def packed_sign_votes(
+    words: jnp.ndarray, d: int, *, impl: str = "xla"
+) -> jnp.ndarray:
+    """Per-coordinate set-bit counts of the packed sign words, [d] int32.
+
+    ``impl="pallas"`` takes the single-pass popcount kernel when K fits the
+    VMEM budget; the rejection is SURFACED like :func:`_sort_fused_ok` —
+    the spelled-out byte math goes to the warning stream so an ``xla``
+    fallback row in the matrix is attributable from the run log alone."""
+    k = words.shape[0]
+    if impl == "pallas":
+        reason = pallas_kernels.signpack_fused_reason(k)
+        if reason is None:
+            return pallas_kernels.packed_vote_counts(words, d)
+        warnings.warn(
+            "packed sign vote: pallas rejected, using the XLA bit-plane "
+            f"fallback — {reason}",
+            stacklevel=3,
+        )
+    return _packed_vote_counts_xla(words, d)
+
+
+def _quantize_deltas(
+    wmatrix: jnp.ndarray, guess: jnp.ndarray, bits: int
+) -> jnp.ndarray:
+    """b-bit symmetric uniform quantize-dequantize EMULATION of the delta
+    channel (sign_bits = 8 or 16): per-client scale ``s_i = max |delta_i|``
+    over finite coordinates, levels ``Q = 2^(b-1) - 1``, so the wire would
+    carry ``k * d * b / 8`` bytes (obs/hbm.py models it).  Returns the
+    reconstructed stack ``guess + dq``; rows with any non-finite
+    coordinate pass through UNCHANGED so the downstream vote's non-finite
+    handling is identical to the unpacked path, and an all-zero delta row
+    (s_i = 0) dequantizes to exactly zero."""
+    delta = wmatrix.astype(jnp.float32) - guess[None, :].astype(jnp.float32)
+    finite = _finite_rows(delta)  # [K]
+    q_max = jnp.float32(2 ** (bits - 1) - 1)
+    scale = jnp.max(jnp.abs(jnp.where(finite[:, None], delta, 0.0)),
+                    axis=1, keepdims=True)  # [K, 1], 0 on invalid rows
+    q = jnp.clip(
+        jnp.round(delta / jnp.maximum(scale, 1e-30) * q_max), -q_max, q_max
+    )
+    dq = jnp.where(scale > 0.0, q * scale / q_max, 0.0)
+    return jnp.where(
+        finite[:, None], guess[None, :].astype(jnp.float32) + dq, wmatrix
+    )
+
+
+def _packed_sign_step(wmatrix, guess, packed, noise, sign_eta, impl, name):
+    """Shared sign_bits=1 tail for signmv/bev: pack (unless the trainer
+    already did), popcount-reduce, recover signed votes, step ``sign_eta``
+    in the voted direction.  ``sign_eta`` is mandatory on this path — the
+    one-bit channel carries no magnitudes for the adaptive eta median."""
+    if sign_eta is None:
+        raise ValueError(
+            f"{name} at sign_bits=1 needs an explicit sign_eta: the "
+            "one-bit channel carries no magnitudes for the adaptive "
+            "eta median"
+        )
+    d = wmatrix.shape[1]
+    if packed is None:
+        packed = pack_signs(wmatrix, guess)
+    words, k_valid = packed
+    counts = packed_sign_votes(words, d, impl=impl)
+    votes = (2 * counts - k_valid).astype(jnp.float32) + noise
+    return guess + jnp.float32(sign_eta) * jnp.sign(votes)
+
+
 @AGGREGATORS.register(
     "signmv",
     owns_channel=True,
-    extra_args=("guess", "key", "noise_var", "sign_eta"),
+    extra_args=(
+        "guess", "key", "noise_var", "sign_eta", "sign_bits", "packed",
+        "impl",
+    ),
 )
 def sign_majority_vote(
     wmatrix: jnp.ndarray,
@@ -607,6 +751,9 @@ def sign_majority_vote(
     key: Optional[jax.Array] = None,
     noise_var: Optional[float] = None,
     sign_eta: Optional[float] = None,
+    sign_bits: int = 32,
+    packed=None,
+    impl: str = "xla",
     **_,
 ) -> jnp.ndarray:
     """One-bit over-the-air aggregation: sign-SGD with majority vote.
@@ -634,6 +781,14 @@ def sign_majority_vote(
     dense memory budget the coordinatewise tail runs over column blocks
     (the [K, d] delta and sorted |delta| temporaries are ~45 GB each at
     the ResNet-18 rung).
+
+    ``sign_bits`` selects the channel payload width: 32 (default) is this
+    legacy full-precision-ballot path, byte-identical with the new kwargs
+    left at their defaults; 1 takes the bit-packed wire
+    (:func:`pack_signs` / :func:`packed_sign_votes` — ``packed`` lets the
+    trainer hand in pre-packed words so the f32 sign stack never
+    materializes); 8/16 run the same vote on a quantize-dequantize
+    emulated stack (:func:`_quantize_deltas`).
     """
     if guess is None:
         raise ValueError("signmv needs the pre-round params as `guess`")
@@ -645,6 +800,12 @@ def sign_majority_vote(
         noise = scale * jax.random.normal(key, (d,), jnp.float32)
     else:
         noise = jnp.zeros((d,), jnp.float32)
+    if sign_bits == 1:
+        return _packed_sign_step(
+            wmatrix, guess, packed, noise, sign_eta, impl, "signmv"
+        )
+    if sign_bits in (8, 16):
+        wmatrix = _quantize_deltas(wmatrix, guess, sign_bits)
 
     def tail(cols, g, n):
         delta = cols - g[None, :]
@@ -667,13 +828,17 @@ def sign_majority_vote(
 
 
 @AGGREGATORS.register(
-    "bev", extra_args=("guess", "sign_eta")
+    "bev",
+    extra_args=("guess", "sign_eta", "sign_bits", "packed", "impl"),
 )
 def best_effort_voting(
     wmatrix: jnp.ndarray,
     *,
     guess: Optional[jnp.ndarray] = None,
     sign_eta: Optional[float] = None,
+    sign_bits: int = 32,
+    packed=None,
+    impl: str = "xla",
     **_,
 ) -> jnp.ndarray:
     """Best-effort voting (BEV-SGD, Jin et al. 2021, arXiv:2110.09660) as
@@ -697,10 +862,23 @@ def best_effort_voting(
     robust step-scale estimate ``signmv`` uses); non-finite rows cast a 0
     ballot and count as +Inf for the eta median, and an Inf median
     (>= K/2 non-finite deltas — outside the contract) degrades that
-    coordinate to a no-op step rather than poisoning the params."""
+    coordinate to a no-op step rather than poisoning the params.
+
+    ``sign_bits`` / ``packed`` make bev the second consumer of the packed
+    one-bit reduce: at ``sign_bits=1`` the ballots are the same uint32
+    sign words ``signmv`` transmits (:func:`pack_signs`), reduced by the
+    same popcount kernel — minus the receiver noise, since bev is a
+    receiver-side rung.  32 is the legacy path, byte-identical; 8/16
+    quantize-dequantize emulation as in ``signmv``."""
     if guess is None:
         raise ValueError("bev needs the pre-round params as `guess`")
     k, d = wmatrix.shape
+    if sign_bits == 1:
+        return _packed_sign_step(
+            wmatrix, guess, packed, jnp.float32(0.0), sign_eta, impl, "bev"
+        )
+    if sign_bits in (8, 16):
+        wmatrix = _quantize_deltas(wmatrix, guess, sign_bits)
 
     def tail(cols, g):
         delta = cols - g[None, :]

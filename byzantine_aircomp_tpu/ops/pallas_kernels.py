@@ -415,3 +415,90 @@ def fused_median(w, *, channel=None, interpret=None):
     k = w.shape[0]
     n_high = k - 1 - (k - 1) // 2
     return _select_call(w, 0, n_high, False, channel, interpret)
+
+
+# ---------------------------------------------------------------------------
+# packed one-bit sign reduce (signmv / bev ballots)
+
+# VMEM residency per [Kp, 128] program of the popcount kernel: the uint32
+# word tile plus ~two same-shaped bit-plane temporaries ((tile >> j) & 1 and
+# its int32 widening) that the compiler keeps live across the lane reduce
+SIGNPACK_STACK_ARRAYS = 3
+SIGNPACK_BITS = 32  # coordinates per uint32 word, LSB-first
+
+
+def signpack_fused_reason(k: int) -> Optional[str]:
+    """Why the popcount majority-vote kernel CANNOT take the fused pallas
+    path — None when it can.  Same contract as :func:`sort_fused_reason`:
+    the byte math is the predicate spelled out so a fallback ``xla`` row in
+    the matrix is attributable from the run log alone.  K-bound like the
+    selection kernels — the grid runs over word columns, so d (= 32 coords
+    per lane) never limits residency."""
+    kp = _round_up(k, 8)
+    need = SIGNPACK_STACK_ARRAYS * kp * LANE * 4
+    if need > VMEM_BLOCK_BUDGET:
+        return (
+            f"K={k} (padded {kp}) needs {need} B of VMEM for the "
+            f"[{kp}, {LANE}] words+bitplane+widened working set "
+            f"({SIGNPACK_STACK_ARRAYS} arrays), over the "
+            f"{VMEM_BLOCK_BUDGET} B block budget"
+        )
+    return None
+
+
+def supports_signpack_fused(k: int) -> bool:
+    """Whether the popcount kernel can hold a full-K [Kp, 128] uint32 word
+    column (plus bit-plane temporaries) in the VMEM block budget.
+    :func:`signpack_fused_reason` is the same predicate with the rejection
+    spelled out for the fallback-matrix log."""
+    return signpack_fused_reason(k) is None
+
+
+def _popcount_kernel(w_ref, out_ref):
+    """One [Kp, 128] uint32 word block: per-bit set counts over K.
+
+    Emits ``out[j, w] = sum_k bit_j(words[k, w])`` as a [32, 128] int32
+    tile.  The transpose back to coordinate order (``c = w*32 + j``,
+    LSB-first) is an O(d) XLA fix-up in the caller — cheap next to the
+    [K, W] read, and it avoids an in-kernel reshape across lanes.  Padded
+    rows were packed as all-zero words, so they add nothing and no padding
+    correction is needed."""
+    words = w_ref[:]  # [Kp, 128] uint32 — the only HBM read of this tile
+    rows = [
+        jnp.sum(
+            ((words >> jnp.uint32(j)) & jnp.uint32(1)).astype(jnp.int32),
+            axis=0,
+            keepdims=True,
+        )
+        for j in range(SIGNPACK_BITS)
+    ]
+    out_ref[:] = jnp.concatenate(rows, axis=0)  # [32, 128] — single store
+
+
+@functools.partial(jax.jit, static_argnames=("d", "interpret"))
+def packed_vote_counts(words: jnp.ndarray, d: int, *, interpret=None):
+    """Per-coordinate set-bit counts of a [K, W] uint32 sign-word stack in a
+    single HBM pass: ``counts[c] = #{k : bit (c % 32) of words[k, c // 32]}``
+    as int32 [d].  Word layout is LSB-first, ``c = w*32 + j`` — the same
+    wire format as ``ops.aggregators.pack_signs`` and the XLA fallback, so
+    the two realizations are bit-identical (integer counts)."""
+    k, w_cnt = words.shape
+    kp = _round_up(k, 8)
+    wp = _round_up(w_cnt, LANE)
+    w_p = jnp.pad(words, ((0, kp - k), (0, wp - w_cnt)))
+    interp = _use_interpret() if interpret is None else interpret
+
+    counts2d = pl.pallas_call(
+        _popcount_kernel,
+        grid=(wp // LANE,),
+        in_specs=[
+            pl.BlockSpec((kp, LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec(
+            (SIGNPACK_BITS, LANE), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((SIGNPACK_BITS, wp), jnp.int32),
+        interpret=interp,
+    )(w_p)
+    # [32, Wp] -> coordinate order: row-major [Wp, 32] flatten is w*32 + j
+    return counts2d.T.reshape(-1)[:d]

@@ -251,13 +251,14 @@ def test_config_hash_off_matches_predefense_formula():
     # for output-only knobs added since — profile_rounds/hbm_warn_factor
     # are excluded from the hash like every other obs knob, and the cohort
     # streaming / service-round fields follow the same off-means-absent
-    # continuity contract)
+    # continuity contract, as does sign_bits at its legacy width of 32)
     skip = (
         "checkpoint_dir", "cache_dir", "profile_dir", "inherit", "rounds",
         "obs_dir", "obs_stdout", "log_file", "quiet",
         "profile_rounds", "hbm_warn_factor",
         "forensics", "forensics_top", "flight_window",
         "metrics", "metrics_port", "alerts", "obs_rotate_mb",
+        "sign_bits",
     )
     items = sorted(
         (f.name, repr(getattr(cfg, f.name)))

@@ -1,0 +1,381 @@
+"""Bit-packed one-bit sign channel (``--sign-bits``): wire-format
+properties vs the numpy oracles, Pallas-vs-XLA reduce parity,
+packed==unpacked vote identity (single step and multi-step trajectory),
+the sign_bits=32 legacy-path guard, the packed-path retrace gate, and
+the config contracts.
+
+The equality tests use stacks whose rows are either fully finite or
+fully non-finite: the packed wire masks non-finite rows at ROW
+granularity (all-zero words, excluded from k_valid) where the unpacked
+vote masks per COORDINATE, so a partially-poisoned row is the one
+documented divergence (DESIGN.md) — not an equality bug.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu.backends import numpy_ref as ref
+from byzantine_aircomp_tpu.data import datasets as data_lib
+from byzantine_aircomp_tpu.fed.config import FedConfig
+from byzantine_aircomp_tpu.fed.train import FedTrainer
+from byzantine_aircomp_tpu.obs import hbm as hbm_lib
+from byzantine_aircomp_tpu.ops import aggregators as agg_lib
+from byzantine_aircomp_tpu.ops import pallas_kernels as pk
+
+
+def _stack(seed=0, k=24, d=70, scale=0.5):
+    """Random stack + pre-round params; d=70 exercises the partial last
+    word (70 = 2*32 + 6) and float deltas never tie exactly at zero."""
+    key = jax.random.PRNGKey(seed)
+    guess = jax.random.normal(jax.random.fold_in(key, 1), (d,), jnp.float32)
+    w = guess[None, :] + scale * jax.random.normal(
+        jax.random.fold_in(key, 2), (k, d), jnp.float32
+    )
+    return w, guess
+
+
+# ------------------------------------------------- wire-format properties
+
+
+def test_pack_matches_numpy_oracle():
+    w, guess = _stack()
+    words, k_valid = agg_lib.pack_signs(w, guess)
+    ow, ok_valid = ref.pack_signs(np.asarray(w), np.asarray(guess))
+    assert words.dtype == jnp.uint32
+    np.testing.assert_array_equal(np.asarray(words), ow)
+    assert int(k_valid) == ok_valid == w.shape[0]
+    assert words.shape == (w.shape[0], agg_lib.packed_words(w.shape[1]))
+
+
+def test_pack_pad_bits_are_zero():
+    # d=70: bits 6..31 of the last word must be zero — padded ballots
+    # would otherwise count as phantom +1 votes in the tail word
+    w, guess = _stack(d=70)
+    words, _ = agg_lib.pack_signs(w, guess)
+    last = np.asarray(words)[:, -1]
+    assert (last >> np.uint32(70 % 32) == 0).all()
+
+
+def test_nonfinite_row_packs_zero_and_drops_from_k_valid():
+    w, guess = _stack(k=8)
+    w = w.at[3, :].set(jnp.nan)
+    w = w.at[5, :].set(jnp.inf)
+    words, k_valid = agg_lib.pack_signs(w, guess)
+    words = np.asarray(words)
+    assert (words[3] == 0).all() and (words[5] == 0).all()
+    assert int(k_valid) == 6
+    # a SINGLE poisoned coordinate still invalidates the whole row
+    w2, _ = _stack(seed=1, k=8)
+    w2 = w2.at[0, 17].set(jnp.nan)
+    words2, k_valid2 = agg_lib.pack_signs(w2, guess)
+    assert (np.asarray(words2)[0] == 0).all() and int(k_valid2) == 7
+
+
+def test_zero_delta_packs_plus_one_ballot():
+    # the documented one-bit convention: delta == 0 (and -0.0) rounds UP
+    # to a +1 ballot on the packed wire, where the unpacked vote says
+    # sign(0) = 0 — pinned here so a silent flip of the convention fails
+    w, guess = _stack(k=4, d=40)
+    w = w.at[:, 0].set(guess[0])          # exact tie at coordinate 0
+    w = w.at[:, 1].set(guess[1] - 0.0)    # -0.0 delta is still a tie
+    words, k_valid = agg_lib.pack_signs(w, guess)
+    words = np.asarray(words)
+    assert (words[:, 0] & 1 == 1).all()           # bit 0 set: ballot +1
+    assert (words[:, 0] >> 1 & 1 == 1).all()      # bit 1 (coord 1) too
+    eta = 0.125
+    stepped = agg_lib.sign_majority_vote(
+        w, guess=guess, sign_eta=eta, sign_bits=1
+    )
+    legacy = agg_lib.sign_majority_vote(w, guess=guess, sign_eta=eta)
+    # packed: unanimous +1 ballots move the coordinate; legacy holds it
+    assert float(stepped[0] - guess[0]) == pytest.approx(eta)
+    assert float(legacy[0] - guess[0]) == 0.0
+
+
+def test_even_k_tie_holds_coordinate():
+    # K=2 opposing ballots: votes = 2*counts - k_valid = 2*1 - 2 = 0 and
+    # sign(0) = 0 — the coordinate must not move at even K
+    d = 40
+    guess = jnp.linspace(-1.0, 1.0, d, dtype=jnp.float32)
+    w = jnp.stack([guess + 1.0, guess - 1.0])
+    out = agg_lib.best_effort_voting(
+        w, guess=guess, sign_eta=0.5, sign_bits=1
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(guess))
+
+
+# ------------------------------------------------- reduce parity
+
+
+def test_counts_parity_pallas_xla_oracle():
+    for seed, k, d in [(0, 24, 70), (1, 7, 33), (2, 40, 257)]:
+        w, guess = _stack(seed=seed, k=k, d=d)
+        if seed == 2:  # adversarial: poisoned rows in the mix
+            w = w.at[0, :].set(jnp.nan)
+            w = w.at[-1, :].set(-jnp.inf)
+        words, _ = agg_lib.pack_signs(w, guess)
+        counts_xla = agg_lib._packed_vote_counts_xla(words, d)
+        counts_pl = pk.packed_vote_counts(words, d)
+        oracle = ref.packed_vote_counts(np.asarray(words), d)
+        np.testing.assert_array_equal(np.asarray(counts_xla), oracle)
+        np.testing.assert_array_equal(
+            np.asarray(counts_pl), np.asarray(counts_xla)
+        )
+
+
+def test_ballots_conserved():
+    # sum of per-coordinate counts == total set bits on the wire (no
+    # ballot is created or lost by the reduce or the coordinate fix-up)
+    w, guess = _stack(k=16, d=100)
+    words, _ = agg_lib.pack_signs(w, guess)
+    counts = agg_lib.packed_sign_votes(words, 100)
+    wire_bits = int(
+        np.asarray(jax.lax.population_count(words), np.int64).sum()
+    )
+    assert int(np.asarray(counts).sum()) == wire_bits
+
+
+def test_packed_sign_votes_pallas_falls_back_loud():
+    # over the VMEM K-bound the dispatcher must WARN and still be
+    # bit-identical to xla (the fallback matrix contract)
+    big_k = 5000
+    assert pk.signpack_fused_reason(big_k) is not None
+    words = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, 2**32, size=(big_k, 2), dtype=np.uint32
+        )
+    )
+    with pytest.warns(UserWarning, match="XLA bit-plane fallback"):
+        counts = agg_lib.packed_sign_votes(words, 64, impl="pallas")
+    np.testing.assert_array_equal(
+        np.asarray(counts),
+        np.asarray(agg_lib._packed_vote_counts_xla(words, 64)),
+    )
+
+
+def test_vmem_gate_reason_spells_out_bytes():
+    assert pk.signpack_fused_reason(8) is None
+    assert pk.supports_signpack_fused(8)
+    reason = pk.signpack_fused_reason(5000)
+    assert reason is not None and not pk.supports_signpack_fused(5000)
+    assert "VMEM" in reason and str(pk.VMEM_BLOCK_BUDGET) in reason
+
+
+# ------------------------------------------------- vote identity
+
+
+def test_signmv_packed_equals_unpacked_finite():
+    w, guess = _stack(k=15, d=90)
+    kw = dict(guess=guess, sign_eta=0.01)
+    a = agg_lib.sign_majority_vote(w, sign_bits=1, **kw)
+    b = agg_lib.sign_majority_vote(w, **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bev_packed_equals_unpacked_finite_and_all_nan_row():
+    w, guess = _stack(k=12, d=50)
+    kw = dict(guess=guess, sign_eta=0.05)
+    np.testing.assert_array_equal(
+        np.asarray(agg_lib.best_effort_voting(w, sign_bits=1, **kw)),
+        np.asarray(agg_lib.best_effort_voting(w, **kw)),
+    )
+    # fully non-finite rows: both paths give them zero ballots
+    w = w.at[2, :].set(jnp.nan)
+    np.testing.assert_array_equal(
+        np.asarray(agg_lib.best_effort_voting(w, sign_bits=1, **kw)),
+        np.asarray(agg_lib.best_effort_voting(w, **kw)),
+    )
+
+
+def test_signmv_noise_applies_to_packed_votes():
+    # the AWGN draw perturbs the SUMMED vote on both paths — oracle check
+    w, guess = _stack(k=9, d=64)
+    key = jax.random.PRNGKey(7)
+    noise_var = 4.0
+    scale = float(np.sqrt(noise_var / 2.0))
+    noise = scale * jax.random.normal(key, (64,), jnp.float32)
+    got = agg_lib.sign_majority_vote(
+        w, guess=guess, key=key, noise_var=noise_var, sign_eta=0.01,
+        sign_bits=1,
+    )
+    want = ref.packed_sign_step(
+        np.asarray(w), np.asarray(guess), 0.01, noise=np.asarray(noise)
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_packed_trajectory_matches_unpacked():
+    # multi-step descent: identical votes each step => identical params
+    # stream => bit-identical trajectories (no zero deltas by construction)
+    key = jax.random.PRNGKey(3)
+    d, k = 48, 10
+    params1 = params32 = jax.random.normal(key, (d,), jnp.float32)
+    for t in range(6):
+        kt = jax.random.fold_in(key, 100 + t)
+        w1 = params1[None, :] + 0.1 * jax.random.normal(
+            kt, (k, d), jnp.float32
+        )
+        w32 = params32[None, :] + 0.1 * jax.random.normal(
+            kt, (k, d), jnp.float32
+        )
+        params1 = agg_lib.sign_majority_vote(
+            w1, guess=params1, sign_eta=0.02, sign_bits=1
+        )
+        params32 = agg_lib.sign_majority_vote(
+            w32, guess=params32, sign_eta=0.02
+        )
+        np.testing.assert_array_equal(
+            np.asarray(params1), np.asarray(params32)
+        )
+
+
+def test_quantized_emulation_8_16_steps_are_sign_steps():
+    # sign_bits=8/16 reconstruct a dequantized stack then run the legacy
+    # vote: every coordinate still moves by exactly {-eta, 0, +eta}
+    w, guess = _stack(k=11, d=70)
+    for bits in (8, 16):
+        out = agg_lib.sign_majority_vote(
+            w, guess=guess, sign_eta=0.01, sign_bits=bits
+        )
+        step = np.asarray(out) - np.asarray(guess)
+        assert np.isfinite(step).all()
+        np.testing.assert_allclose(
+            np.abs(step)[np.abs(step) > 0], 0.01, rtol=1e-6
+        )
+    # 16-bit quantization is fine enough that votes rarely flip: the
+    # step directions must agree with full precision on >= 95% of coords
+    full = np.asarray(
+        agg_lib.sign_majority_vote(w, guess=guess, sign_eta=0.01)
+    ) - np.asarray(guess)
+    q16 = np.asarray(
+        agg_lib.sign_majority_vote(
+            w, guess=guess, sign_eta=0.01, sign_bits=16
+        )
+    ) - np.asarray(guess)
+    assert (np.sign(full) == np.sign(q16)).mean() >= 0.95
+
+
+# ------------------------------------------------- trainer integration
+
+
+def _tiny_ds(k):
+    return data_lib.load("mnist", synthetic_train=32 * k, synthetic_val=64)
+
+
+def test_sign_bits_32_never_touches_pack_machinery(monkeypatch):
+    # the legacy-path guard: at the default width the trainer and the
+    # aggregator must not even CALL the packed helpers — byte-identical
+    # to the pre-feature build by construction
+    def boom(*a, **kw):
+        raise AssertionError("pack_signs called on the sign_bits=32 path")
+
+    monkeypatch.setattr(agg_lib, "pack_signs", boom)
+    cfg = FedConfig(
+        honest_size=6, byz_size=0, rounds=2, display_interval=5,
+        batch_size=16, agg="signmv", sign_eta=0.01, eval_train=False,
+    )
+    FedTrainer(cfg, dataset=_tiny_ds(6)).train()
+
+
+def test_packed_trainer_round_single_lowering(tmp_path, monkeypatch):
+    """CI retrace-gate member: fusing pack_signs into the stack
+    materialization must not add lowerings — the packed resident round
+    fn traces exactly once."""
+    import byzantine_aircomp_tpu.data.datasets as dl
+    from byzantine_aircomp_tpu.fed import harness
+    from byzantine_aircomp_tpu.obs import events_path
+
+    orig = dl.load
+    monkeypatch.setattr(
+        dl, "load",
+        lambda name, **kw: orig(name, synthetic_train=600, synthetic_val=200),
+    )
+    # node_size=6 keeps the single-program layout (conftest forces 8
+    # host devices; 8 participants would auto-shard)
+    cfg = FedConfig(
+        honest_size=4, byz_size=2, rounds=3, display_interval=2,
+        batch_size=16, agg="signmv", attack="signflip", sign_eta=0.01,
+        sign_bits=1, eval_train=False, obs_dir=str(tmp_path / "obs"),
+    )
+    harness.run(cfg, record_in_file=False)
+    path = events_path(str(tmp_path / "obs"), harness.ckpt_title(cfg))
+    events = [json.loads(l) for l in open(path)]
+    (ret,) = [e for e in events if e["kind"] == "retrace"]
+    assert ret["counts"]["round_fn"] == 1 and ret["steady_state_ok"]
+
+
+def test_config_hash_and_title_continuity():
+    from byzantine_aircomp_tpu.fed import harness
+
+    base = dict(
+        honest_size=6, byz_size=0, rounds=2, batch_size=16,
+        agg="signmv", sign_eta=0.01, eval_train=False,
+    )
+    sb32 = FedConfig(sign_bits=32, **base)
+    default = FedConfig(**base)
+    packed = FedConfig(sign_bits=1, **base)
+    # 32 is hash- and title-invisible (checkpoint continuity with builds
+    # that predate the field); 1 changes both
+    assert harness.config_hash(sb32) == harness.config_hash(default)
+    assert harness.config_hash(packed) != harness.config_hash(default)
+    assert harness.run_title(sb32) == harness.run_title(default)
+    assert harness.run_title(packed).endswith("_sb1")
+
+
+# ------------------------------------------------- config contracts
+
+
+_CFG = dict(
+    honest_size=6, byz_size=0, rounds=1, batch_size=16, eval_train=False,
+)
+
+
+def test_config_rejects_unknown_width():
+    with pytest.raises(ValueError, match="one of 1, 8, 16, 32"):
+        FedConfig(agg="signmv", sign_bits=4, sign_eta=0.01, **_CFG).validate()
+
+
+def test_config_rejects_packed_non_sign_aggregator():
+    with pytest.raises(ValueError, match="SIGN channel"):
+        FedConfig(agg="mean", sign_bits=1, sign_eta=0.01, **_CFG).validate()
+    with pytest.raises(ValueError, match="SIGN channel"):
+        FedConfig(agg="median", sign_bits=8, **_CFG).validate()
+
+
+def test_config_rejects_packed_bucketing():
+    with pytest.raises(ValueError, match="bucket"):
+        FedConfig(
+            agg="bev", sign_bits=1, sign_eta=0.01, bucket_size=2, **_CFG
+        ).validate()
+
+
+def test_config_rejects_packed_without_sign_eta():
+    with pytest.raises(ValueError, match="sign-eta"):
+        FedConfig(agg="signmv", sign_bits=1, **_CFG).validate()
+
+
+def test_aggregator_rejects_packed_without_sign_eta():
+    w, guess = _stack(k=4, d=40)
+    for fn, name in (
+        (agg_lib.sign_majority_vote, "signmv"),
+        (agg_lib.best_effort_voting, "bev"),
+    ):
+        with pytest.raises(ValueError, match=f"{name} at sign_bits=1"):
+            fn(w, guess=guess, sign_bits=1)
+
+
+# ------------------------------------------------- bandwidth model
+
+
+def test_packed_stack_bytes_within_1_over_24():
+    for k, d in [(16, 24), (100, 7850), (1000, 100_000)]:
+        packed = hbm_lib.packed_stack_bytes(k, d, 1)
+        full = hbm_lib.stack_bytes(k, d)
+        assert packed / full <= 1.0 / 24.0, (k, d, packed / full)
+    # wider emulated payloads scale linearly in bits
+    assert hbm_lib.packed_stack_bytes(10, 80, 8) == 10 * 80
+    assert hbm_lib.packed_stack_bytes(10, 80, 16) == 10 * 80 * 2

@@ -145,6 +145,7 @@ def test_sweep_forwards_every_shared_knob():
         "clip_tau": 1.5,
         "clip_iters": 5,
         "sign_eta": 0.01,
+        "sign_bits": 1,
         "dnc_iters": 2,
         "dnc_sub_dim": 64,
         "dnc_c": 0.5,
@@ -196,6 +197,9 @@ def test_sweep_forwards_every_shared_knob():
                      "churn_departure", "straggler_prob", "rollback",
                      "rollback_loss_factor", "rollback_cusum",
                      "rollback_widen", "rollback_max"}
+    # the packed sign channel needs a sign-vote consumer and an explicit
+    # step size (config.validate), so --sign-bits rides its own signmv cell
+    sign_dests = {"sign_bits"}
     probe = argparse.ArgumentParser()
     add_knob_flags(probe)
     flag_of = {
@@ -214,16 +218,20 @@ def test_sweep_forwards_every_shared_knob():
     orig = sweep_mod.run_sweep
     groups = (
         set(flag_of) - fault_dests - defense_dests - cohort_dests
-        - service_dests,
+        - service_dests - sign_dests,
         fault_dests,
         defense_dests,
         cohort_dests,
         service_dests,
+        sign_dests,
     )
     for group in groups:
         argv = list(base)
         if group is service_dests:
             argv += ["--defense", "monitor"]
+        if group is sign_dests:
+            argv[argv.index("mean")] = "signmv"
+            argv += ["--sign-eta", "0.01"]
         for dest in sorted(group):
             argv += [flag_of[dest], str(samples[dest])]
 
