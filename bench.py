@@ -266,6 +266,17 @@ def run_stream_ksweep() -> None:
     ``BENCH_KSWEEP_COHORT``, ``BENCH_KSWEEP_AGG``, ``BENCH_KSWEEP_ROUNDS``
     (timed rounds per K).  Runs on whatever backend the env selects — the
     CI smoke pins JAX_PLATFORMS=cpu.
+
+    SERVICE mode (``BENCH_KSWEEP_SERVICE=1``): the K entries become
+    POPULATION sizes for an always-on service round — each round draws a
+    ``BENCH_KSWEEP_NODE``-participant cohort from the K-id population and
+    streams it in ``BENCH_KSWEEP_COHORT`` chunks, optionally sharded over
+    the population mesh (``BENCH_KSWEEP_POP_SHARDS``, comma list — one
+    row per (K, pop_shards) pair; shard counts above the device count are
+    skipped).  Service rows record ``k = population`` (the id space the
+    round draws from — THE axis of the K=1M acceptance row) and carry
+    ``population``, ``pop_shards`` and the per-host streamed peak model
+    alongside the measured watermark.
     """
     ks = [
         int(s)
@@ -275,6 +286,13 @@ def run_stream_ksweep() -> None:
     cohort = int(os.environ.get("BENCH_KSWEEP_COHORT", "32"))
     agg = os.environ.get("BENCH_KSWEEP_AGG", "median")
     timed = int(os.environ.get("BENCH_KSWEEP_ROUNDS", "2"))
+    service = os.environ.get("BENCH_KSWEEP_SERVICE", "") not in ("", "0")
+    node = int(os.environ.get("BENCH_KSWEEP_NODE", "1000"))
+    shard_list = [
+        int(s)
+        for s in os.environ.get("BENCH_KSWEEP_POP_SHARDS", "1").split(",")
+        if s.strip()
+    ]
 
     import jax
     import jax.numpy as jnp
@@ -286,61 +304,122 @@ def run_stream_ksweep() -> None:
     from byzantine_aircomp_tpu.obs.profile import device_memory
 
     platform = jax.default_backend()
+    n_dev = len(jax.devices())
     log(f"stream_ksweep: backend={platform} Ks={ks} cohort={cohort} "
-        f"agg={agg} timed={timed}")
+        f"agg={agg} timed={timed} service={service} "
+        f"pop_shards={shard_list if service else '-'}")
     for k in ks:
-        if k % cohort:
-            log(f"stream_ksweep: skipping K={k} "
-                f"(not divisible by cohort {cohort})")
-            continue
-        cfg = FedConfig(
-            honest_size=k,
-            byz_size=0,
-            agg=agg,
-            cohort_size=cohort,
-            rounds=1 + timed,
-            display_interval=1,
-            batch_size=8,
-            eval_train=False,
-            agg_maxiter=100,
-        )
-        ds = data_lib.load("mnist", synthetic_train=4 * k, synthetic_val=256)
-        trainer = FedTrainer(cfg, dataset=ds)
-        trainer.run_rounds(0, 1)  # compile + one warmup round
-        float(jnp.sum(trainer.flat_params))
-        t0 = time.perf_counter()
-        trainer.run_rounds(1, timed)
-        float(jnp.sum(trainer.flat_params))  # honest completion barrier
-        dt = time.perf_counter() - t0
-        mem = device_memory()
-        row = make_bench_row(
-            timed / dt,
-            platform=platform,
-            timed_rounds=timed,
-            params={
-                "k": k, "b": 0, "agg": agg, "attack": None,
-                "dataset": "mnist", "model": "MLP",
-                "metric": "stream_ksweep",
-            },
-        )
-        row["cohort_size"] = cohort
-        row["d"] = int(trainer.dim)
-        row["peak_measured_bytes"] = int(mem["peak_bytes_in_use"])
-        row["peak_source"] = str(mem["source"])
-        row["peak_streamed_modeled_bytes"] = hbm_lib.streamed_peak_bytes(
-            k, trainer.dim, cohort
-        )
-        row["peak_resident_modeled_bytes"] = hbm_lib.modeled_peak_bytes(
-            k, trainer.dim
-        )
-        log(
-            f"stream_ksweep: K={k} d={trainer.dim} {timed / dt:.3f} "
-            f"rounds/sec, peak {mem['peak_bytes_in_use']} B "
-            f"({mem['source']}), streamed model "
-            f"{row['peak_streamed_modeled_bytes']} B, resident model "
-            f"{row['peak_resident_modeled_bytes']} B"
-        )
-        emit_row(row)
+        for ps in (shard_list if service else [1]):
+            if service:
+                if k % node:
+                    log(f"stream_ksweep: skipping population {k} "
+                        f"(not a multiple of node_size {node})")
+                    continue
+                if ps > 1 and n_dev < ps:
+                    log(f"stream_ksweep: skipping pop_shards={ps} "
+                        f"(only {n_dev} devices)")
+                    continue
+                if (node // cohort) % ps:
+                    log(f"stream_ksweep: skipping pop_shards={ps} "
+                        f"({node // cohort} chunks not divisible)")
+                    continue
+                cfg = FedConfig(
+                    honest_size=node, byz_size=0, agg=agg,
+                    cohort_size=cohort, rounds=1 + timed,
+                    display_interval=1, batch_size=8, eval_train=False,
+                    agg_maxiter=100, service="on", population=k,
+                    straggler_prob=0.1, pop_shards=ps,
+                )
+                n_train = 4 * node
+            else:
+                if k % cohort:
+                    log(f"stream_ksweep: skipping K={k} "
+                        f"(not divisible by cohort {cohort})")
+                    continue
+                cfg = FedConfig(
+                    honest_size=k, byz_size=0, agg=agg,
+                    cohort_size=cohort, rounds=1 + timed,
+                    display_interval=1, batch_size=8, eval_train=False,
+                    agg_maxiter=100,
+                )
+                n_train = 4 * k
+            ds = data_lib.load(
+                "mnist", synthetic_train=n_train, synthetic_val=256
+            )
+            if ps > 1:
+                # device count already checked above, so the harness's
+                # engine pick always lands on the mesh trainer here
+                from byzantine_aircomp_tpu.parallel import (
+                    PopShardedFedTrainer,
+                )
+                trainer = PopShardedFedTrainer(cfg, dataset=ds)
+            else:
+                trainer = FedTrainer(cfg, dataset=ds)
+            trainer.run_rounds(0, 1)  # compile + one warmup round
+            float(jnp.sum(trainer.flat_params))
+            t0 = time.perf_counter()
+            trainer.run_rounds(1, timed)
+            float(jnp.sum(trainer.flat_params))  # honest completion barrier
+            dt = time.perf_counter() - t0
+            mem = device_memory()
+            row = make_bench_row(
+                timed / dt,
+                platform=platform,
+                timed_rounds=timed,
+                params={
+                    "k": k, "b": 0, "agg": agg, "attack": None,
+                    "dataset": "mnist", "model": "MLP",
+                    "metric": "stream_ksweep",
+                },
+            )
+            if service:
+                # part of the ledger config key: rows at different shard
+                # counts are different configurations (the scaling curve),
+                # not noise around one baseline; None-skipped for classic
+                # rows so their historical keys are unchanged
+                row["pop_shards"] = ps
+            row["cohort_size"] = cohort
+            row["d"] = int(trainer.dim)
+            row["peak_measured_bytes"] = int(mem["peak_bytes_in_use"])
+            row["peak_source"] = str(mem["source"])
+            if service:
+                row["population"] = k
+                # per-participant surviving state: the [population] avail
+                # bools, expressed per drawn participant (fed/harness.py
+                # uses the same accounting in its run_end summary)
+                state_pc = k // node
+                row["peak_streamed_modeled_bytes"] = (
+                    hbm_lib.streamed_peak_bytes(
+                        node, trainer.dim, cohort,
+                        state_bytes_per_client=state_pc,
+                    )
+                )
+                row["peak_per_host_modeled_bytes"] = (
+                    hbm_lib.streamed_peak_bytes(
+                        node, trainer.dim, cohort,
+                        state_bytes_per_client=state_pc, pop_shards=ps,
+                    )
+                )
+                row["peak_resident_modeled_bytes"] = (
+                    hbm_lib.modeled_peak_bytes(node, trainer.dim)
+                )
+            else:
+                row["peak_streamed_modeled_bytes"] = (
+                    hbm_lib.streamed_peak_bytes(k, trainer.dim, cohort)
+                )
+                row["peak_resident_modeled_bytes"] = (
+                    hbm_lib.modeled_peak_bytes(k, trainer.dim)
+                )
+            log(
+                f"stream_ksweep: K={k}"
+                + (f" ps={ps}" if service else "")
+                + f" d={trainer.dim} {timed / dt:.3f} "
+                f"rounds/sec, peak {mem['peak_bytes_in_use']} B "
+                f"({mem['source']}), streamed model "
+                f"{row['peak_streamed_modeled_bytes']} B, resident model "
+                f"{row['peak_resident_modeled_bytes']} B"
+            )
+            emit_row(row)
 
 
 # --------------------------------------------------------------------------

@@ -48,10 +48,15 @@ from .defense_trace import load_events
 
 
 def order_events(events: List[dict]) -> List[dict]:
-    """Stable-sort by the per-sink ``seq`` stamp when every event carries
-    one (v2 sinks); otherwise file order is the only order there is."""
+    """Stable-sort by ``(host_id, seq)`` when every event carries a
+    ``seq`` stamp (v2 sinks); otherwise file order is the only order
+    there is.  ``seq`` is only per-SINK monotonic — on a multi-host
+    population mesh each process appends its own stream and both start
+    at 0, so a concatenated multi-host stream needs the v5 ``host_id``
+    envelope key as the major sort key.  v<5 events lack it and default
+    to host 0, which reproduces the old pure-``seq`` order exactly."""
     if events and all("seq" in e for e in events):
-        return sorted(events, key=lambda e: e["seq"])
+        return sorted(events, key=lambda e: (e.get("host_id", 0), e["seq"]))
     return events
 
 

@@ -302,6 +302,7 @@ def main(argv=None) -> None:
         rollback_cusum=args.rollback_cusum,
         rollback_widen=args.rollback_widen,
         rollback_max=args.rollback_max,
+        pop_shards=args.pop_shards,
     )
     # stdout keeps one JSON object per completed cell (the shape scripts
     # already parse — schema stamps v/kind/ts are additive); --obs-dir tees

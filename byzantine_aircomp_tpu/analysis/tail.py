@@ -191,15 +191,37 @@ def _feed_line(renderer: Renderer, line: str) -> None:
 
 
 def replay(path: str, renderer: Renderer) -> None:
-    """Replay rotated segments then the live file, oldest first."""
+    """Replay rotated segments then the live file, oldest first.
+
+    When every buffered event carries a ``seq`` stamp, the replay is
+    re-sorted by ``(host_id, seq)`` before feeding the renderer: a
+    multi-host population-sharded run's processes each append their own
+    stream (both ``seq`` counters start at 0), and a stream assembled by
+    concatenating them only interleaves correctly under the v5
+    ``host_id`` major key (v<5 events default to host 0, reproducing the
+    old pure-``seq`` order).  Live ``follow`` output past the backfill
+    stays in arrival order — a tail cannot sort the future."""
     from ..obs.sinks import rotated_segments
 
+    events: List[Dict] = []
     for p in rotated_segments(path) + [path]:
         if not os.path.exists(p):
             continue
         with open(p) as f:
             for line in f:
-                _feed_line(renderer, line)
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail of a live write
+                if isinstance(event, dict):
+                    events.append(event)
+    if events and all("seq" in e for e in events):
+        events.sort(key=lambda e: (e.get("host_id", 0), e["seq"]))
+    for event in events:
+        renderer.feed(event)
 
 
 def follow(target: str, renderer: Renderer, interval: float = 0.5,

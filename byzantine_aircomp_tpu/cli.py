@@ -183,6 +183,13 @@ def add_knob_flags(p) -> None:
     p.add_argument("--rollback-max", type=int, default=3,
                    help="rollback budget per run (after it is spent the "
                         "guard reports but no longer restores)")
+    p.add_argument("--pop-shards", type=int, default=1,
+                   help="shard the streamed service round's cohort chunks "
+                        "over this many owners: a device mesh when the "
+                        "devices exist (parallel/popmesh.py), a sequential "
+                        "reference engine otherwise; 1 = the legacy "
+                        "single-scan program (requires --service on with "
+                        "--cohort-size when > 1)")
 
 
 ARG_TO_FIELD = {
@@ -243,6 +250,7 @@ ARG_TO_FIELD = {
     "rollback_cusum": ("rollback_cusum", None),
     "rollback_widen": ("rollback_widen", None),
     "rollback_max": ("rollback_max", None),
+    "pop_shards": ("pop_shards", None),
     "profile_dir": ("profile_dir", None),
     "profile_rounds": ("profile_rounds", None),
     "hbm_warn_factor": ("hbm_warn_factor", None),

@@ -276,6 +276,18 @@ class FedConfig:
     rollback_cusum: float = 0.0
     rollback_widen: float = 1.5
     rollback_max: int = 3
+    # population-axis sharding for streamed service rounds: split the
+    # n_chunks cohort chunks of every round over S shard owners (one
+    # device each when a mesh is available, a sequential lax.map over
+    # shard ids otherwise) and merge the partial aggregates with the
+    # fixed algebra in ops/shardctx.py.  1 (default) traces the legacy
+    # single-scan program byte-identically and is skipped from
+    # config_hash; > 1 requires --service on with a streamed cohort and
+    # forks the hash/title lineage exactly like --cohort-size does
+    # (float partial sums reassociate across the shard fold).  NOT in
+    # _SERVICE_KNOBS: the hash-skip condition is pop_shards == 1, not
+    # service == "off"
+    pop_shards: int = 1
 
     def participant_counts(self) -> tuple:
         """(honest, Byzantine) rows per iteration — the single source of
@@ -949,6 +961,36 @@ class FedConfig:
             if self.rollback_max < 1:
                 raise ValueError(
                     f"rollback_max must be >= 1, got {self.rollback_max}"
+                )
+        if self.pop_shards < 1:
+            raise ValueError(
+                f"pop_shards must be >= 1, got {self.pop_shards}"
+            )
+        if self.pop_shards > 1:
+            if self.service != "on":
+                raise ValueError(
+                    "--pop-shards > 1 shards the service population's "
+                    "cohort chunks over owners — it requires --service on"
+                )
+            if self.cohort_size <= 0:
+                raise ValueError(
+                    "--pop-shards > 1 shards the STREAMED chunk scan; set "
+                    "--cohort-size > 0 (the resident path has its own "
+                    "client-axis sharding via --sharded)"
+                )
+            n_chunks = self.node_size // self.cohort_size
+            if n_chunks % self.pop_shards:
+                raise ValueError(
+                    f"pop_shards {self.pop_shards} must divide the "
+                    f"per-round chunk count {n_chunks} (node_size "
+                    f"{self.node_size} / cohort_size {self.cohort_size}) "
+                    f"so every shard owns the same number of cohort chunks"
+                )
+            if self.forensics != "off":
+                raise ValueError(
+                    "--forensics needs the round's full top-M merge "
+                    "stream, which is not shard-mergeable; use "
+                    "--pop-shards 1 for forensic runs"
                 )
         return self
 

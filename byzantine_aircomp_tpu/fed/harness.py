@@ -153,6 +153,11 @@ def run_title(cfg: FedConfig) -> str:
         for knob in FedConfig._SERVICE_KNOBS:
             if knob != "population" and _non_default(cfg, knob):
                 title += f"_{knob.replace('_', '')}{getattr(cfg, knob)}"
+    if cfg.pop_shards > 1:
+        # pop-sharding reassociates the float partial-sum fold (cohort
+        # idiom: the lineage forks like --cohort-size), so sharded
+        # checkpoints never alias the single-scan trajectory
+        title += f"_ps{cfg.pop_shards}"
     if _non_default(cfg, "prng_impl"):
         title += f"_prng{cfg.prng_impl}"
     if _non_default(cfg, "stack_dtype"):
@@ -233,6 +238,12 @@ def config_hash(cfg: FedConfig) -> str:
         # must hash identically to builds that predate them (validate()
         # pins every service knob to its default when service is off)
         skip = skip + ("service",) + FedConfig._SERVICE_KNOBS
+    if cfg.pop_shards == 1:
+        # pop-shard continuity: the default single-scan engine must hash
+        # identically to builds that predate population sharding.  NOT
+        # keyed on service — pop_shards > 1 always forks (the shard fold
+        # reassociates float sums), even though it requires --service on
+        skip = skip + ("pop_shards",)
     if cfg.sign_bits == 32:
         # same continuity contract: a full-width (legacy) sign channel
         # must hash identically to builds that predate the sign_bits
@@ -332,6 +343,18 @@ def _make_trainer(cfg: FedConfig, trainer_cls):
     from .train import FedTrainer
 
     n_dev = len(jax.devices())
+    if cfg.pop_shards > 1 and trainer_cls is FedTrainer:
+        # population-axis sharding (streamed service rounds) is its own
+        # layout: the mesh engine when the devices exist, the sequential
+        # reference engine otherwise (sharded=False forces sequential —
+        # useful for parity baselines on a multi-device host)
+        if cfg.sharded is not False and n_dev >= cfg.pop_shards:
+            from ..parallel import PopShardedFedTrainer
+
+            log(f"Population-sharded execution over {cfg.pop_shards} devices")
+            return PopShardedFedTrainer(cfg)
+        log(f"Population shards x{cfg.pop_shards} (sequential engine)")
+        return FedTrainer(cfg)
     if trainer_cls is FedTrainer:
         from ..parallel import ShardedFedTrainer, mesh as mesh_lib
 
@@ -612,8 +635,11 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
                 cfg.node_size, trainer.dim, cfg.cohort_size,
                 data_bytes=data_bytes,
                 state_bytes_per_client=state_pc,
+                pop_shards=cfg.pop_shards,
             )
-            memory["hbm_model"] = "streamed"
+            memory["hbm_model"] = (
+                "streamed_per_host" if cfg.pop_shards > 1 else "streamed"
+            )
         else:
             modeled = hbm_lib.modeled_peak_bytes(
                 cfg.node_size, trainer.dim, data_bytes=data_bytes
@@ -621,6 +647,31 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
             memory["hbm_model"] = "resident"
         memory["modeled_peak_bytes"] = modeled
         memory["warn_factor"] = cfg.hbm_warn_factor
+        if cfg.pop_shards > 1:
+            # mesh runs: the model above is the PER-HOST budget, so the
+            # cross-check target is each owner's own watermark, not the
+            # first device's (which `device_memory` returns) and not a
+            # mesh-wide total.  Emit every owner's row and judge the
+            # worst one; host_rss rows are reported but never judged.
+            mesh_devs = getattr(
+                getattr(trainer, "pop_mesh", None), "devices", None
+            )
+            per_host = profile_lib.per_device_memory(
+                None if mesh_devs is None else list(mesh_devs.flat)
+            )
+            memory["per_host"] = per_host
+            judged = [
+                r["peak_bytes_in_use"]
+                for r in per_host
+                if str(r.get("source", "")).startswith("device")
+            ]
+            if judged:
+                memory["peak_bytes_in_use"] = max(judged)
+                memory["source"] = next(
+                    r["source"]
+                    for r in per_host
+                    if str(r.get("source", "")).startswith("device")
+                )
         exceeds = (
             str(memory.get("source", "")).startswith("device")
             and memory["peak_bytes_in_use"] > cfg.hbm_warn_factor * modeled

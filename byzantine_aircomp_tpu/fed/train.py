@@ -49,6 +49,7 @@ from ..ops import attacks as attack_lib
 from ..ops import channel as channel_lib
 from ..ops import faults as fault_lib
 from ..ops import flatten as flatten_lib
+from ..ops import shardctx as shardctx_lib
 from ..registry import DATASETS, MODELS
 from .config import FedConfig
 
@@ -366,6 +367,13 @@ class FedTrainer:
         impl = "threefry2x32" if cfg.prng_impl == "threefry" else cfg.prng_impl
         self._base_key = jax.random.key(cfg.seed, impl=impl)
 
+        # population-shard context (ops/shardctx.py): LOCAL keeps the
+        # legacy single-scan streamed trace; pop_shards > 1 runs the
+        # sequential reference engine here, and the mesh trainer
+        # (parallel/popmesh.py) overrides _make_pop_ctx/_pop_shard_region
+        # with the shard_map collective engine
+        self._pop_ctx = self._make_pop_ctx()
+
         copts = self._jit_compiler_options()
         # retrace detector (obs/retrace.py): counts lowerings of the jitted
         # hot paths.  The counter wrapper sits UNDER jit and is pure Python
@@ -375,14 +383,15 @@ class FedTrainer:
         # args 3-6 are the fault / defense / attack-onset / service states —
         # empty pytrees when the corresponding feature is off, so their
         # donation slots contribute no buffers to the default program
+        donate = self._round_donate_argnums()
         self._round_fn = jax.jit(
             self.retrace.wrap("round_fn", self._build_round_fn()),
-            donate_argnums=(0, 1, 2, 3, 4, 5, 6),
+            donate_argnums=donate,
             compiler_options=copts,
         )
         self._multi_round_fn = jax.jit(
             self.retrace.wrap("multi_round_fn", self._build_multi_round_fn()),
-            donate_argnums=(0, 1, 2, 3, 4, 5, 6),
+            donate_argnums=donate,
             compiler_options=copts,
         )
         self._eval_fn = jax.jit(
@@ -398,6 +407,13 @@ class FedTrainer:
         register those debug options, so they must ride CompileOptions)."""
         return None
 
+    def _round_donate_argnums(self):
+        """Donation slots for the 7 round-carry args.  The pop-mesh
+        trainer (``parallel/popmesh.py``) narrows this on the CPU client,
+        where donating replicated multi-device buffers through a
+        ``shard_map`` program is unsound in this jaxlib."""
+        return (0, 1, 2, 3, 4, 5, 6)
+
     # sharding hooks — identity on a single device; the parallel layer
     # (``..parallel.sharded``) overrides these with with_sharding_constraint
     # so the SAME pure round function drives the multi-chip path.
@@ -406,6 +422,19 @@ class FedTrainer:
 
     def _constrain_params(self, flat_params):
         return flat_params
+
+    # population-shard hooks (streamed service rounds; ops/shardctx.py).
+    # The base trainer runs the chunk region inline — a plain call under
+    # LOCAL (pop_shards == 1) or the sequential reference engine;
+    # ``parallel.popmesh.PopShardedFedTrainer`` overrides both to wrap the
+    # SAME region body in shard_map over a population mesh axis.
+    def _make_pop_ctx(self):
+        if self.cfg.pop_shards > 1:
+            return shardctx_lib.SeqShardCtx(self.cfg.pop_shards)
+        return shardctx_lib.LOCAL
+
+    def _pop_shard_region(self, fn, region_in):
+        return fn(self._pop_ctx, region_in)
 
     # ------------------------------------------------------------------
     # pure functions
@@ -1101,9 +1130,6 @@ class FedTrainer:
             attack_iter, service_state,
         ) = carry
         m_h, m_b = self._part_h, self._part_b  # participating counts
-        # iteration-start defense snapshot for the attack's DefenseView —
-        # ``defense_state`` itself is rebound mid-body (see rebuild_full)
-        defense_state_in = defense_state
         cohort = cfg.cohort_size
         n_h_chunks = m_h // cohort
         n_chunks = n_h_chunks + m_b // cohort
@@ -1202,315 +1228,426 @@ class FedTrainer:
             k_batch, offsets, sizes, steps_b
         )
 
-        def rebuild_full(c_idx):
-            """([cohort, d] chunk, new GE slice, n_erased, n_corrupt) for
-            one cohort — pure in c_idx, so every aggregator pass that
-            re-invokes it sees identical chunks."""
-            off = c_idx * cohort
-            mask_c = jax.lax.dynamic_slice_in_dim(byz_mask, off, cohort)
-            if attack_on is not None:
-                mask_c = mask_c & attack_on
-            idx = jax.lax.dynamic_slice_in_dim(idx_all, off, cohort, axis=0)
-            x = x_train[idx]
-            if self._norm_scale is not None:
-                x = x.astype(jnp.float32) * self._norm_scale + self._norm_bias
-            shape = (cohort, cfg.local_steps, cfg.batch_size)
-            x = x.reshape(
-                shape + (self._sample_shape if self._spatial_input else (-1,))
-            )
-            y = y_train[idx].reshape(shape)
-            chunk = self._constrain_stack(
-                self._client_stack(flat_params, x, y, mask_c)
-            )
+        needs_ge = self.fault is not None and self.fault.needs_ge
 
-            if self.attack is not None and self.attack.message_fn is not None:
-                # cohort purity: byz chunks are the LAST ones, so byz_size =
-                # cohort attacks the whole chunk and the scalar gate keeps
-                # honest chunks untouched (row-local attacks only —
-                # cfg.validate rejects the omniscient ones)
-                is_byz_chunk = c_idx >= n_h_chunks
-                d_view = None
-                if self.attack.defense_aware:
-                    # chunk-local slice of the PREVIOUS iteration's
-                    # published detector rows.  MUST read the iteration-
-                    # start snapshot, not ``defense_state``: that variable
-                    # is rebound (step+1, new rung) after the observation
-                    # scan but BEFORE the aggregation pass re-invokes this
-                    # closure, and a post-update view would make the two
-                    # passes rebuild different chunks (and break resident
-                    # parity at the attack's schedule boundaries)
-                    det_s, pol_s = defense_state_in
-                    step_s, ema_s, dev_s, cus_s = det_s
+        # ---- population-shard region.  Everything that touches chunk
+        # CONTENTS (rebuild, the observation scan, the detector updates,
+        # the aggregation passes) lives in ``core`` below, a pure function
+        # of the ``region_in`` dict: every traced value enters through the
+        # dict (trainer constants -- byz mask, normalization vectors --
+        # are closure-captured and lifted as replicated), so the SAME body
+        # runs three ways via ``_pop_shard_region``: a plain call under
+        # ops/shardctx.LOCAL (pop_shards == 1, the legacy byte-identical
+        # trace), the sequential reference engine (SeqShardCtx,
+        # pop_shards > 1 on one device), or shard_map over the population
+        # mesh axis (parallel/popmesh.py), where each device scans its own
+        # chunk range and the partials merge by the shardctx tag algebra
+        # (docs/DESIGN.md "Pod-scale service rounds").
+        region_in = dict(
+            flat_params=flat_params,
+            idx_all=idx_all,
+            x_train=x_train,
+            y_train=y_train,
+            k_msg=k_msg,
+            k_chan=k_chan,
+        )
+        if attack_on is not None:
+            region_in["attack_on"] = attack_on
+        if self.fault is not None:
+            region_in["k_trans"] = k_trans
+            region_in["ge_bad"] = ge_bad
+        if cfg.service == "on":
+            region_in["pop_ids"] = pop_ids
+            region_in["missed"] = missed
+            region_in["widen"] = widen
+        if self.defense is not None:
+            region_in["defense_state"] = defense_state
+
+        def core(ctx, rin):
+            # bind every traced value locally so nothing below closes over
+            # a tracer from outside the (possibly shard_map-wrapped)
+            # region boundary
+            flat_params = rin["flat_params"]
+            idx_all = rin["idx_all"]
+            x_train = rin["x_train"]
+            y_train = rin["y_train"]
+            k_msg = rin["k_msg"]
+            k_chan = rin["k_chan"]
+            attack_on = rin.get("attack_on")
+            k_trans = rin.get("k_trans")
+            ge_bad = rin.get("ge_bad", ())
+            pop_ids = rin.get("pop_ids")
+            missed = rin.get("missed")
+            widen = rin.get("widen")
+            defense_state_in = rin.get("defense_state")
+            sharded = ctx.n_shards > 1
+
+            def rebuild_full(c_idx):
+                """([cohort, d] chunk, new GE slice, n_erased, n_corrupt) for
+                one cohort — pure in c_idx, so every aggregator pass that
+                re-invokes it sees identical chunks."""
+                off = c_idx * cohort
+                mask_c = jax.lax.dynamic_slice_in_dim(byz_mask, off, cohort)
+                if attack_on is not None:
+                    mask_c = mask_c & attack_on
+                idx = jax.lax.dynamic_slice_in_dim(idx_all, off, cohort, axis=0)
+                x = x_train[idx]
+                if self._norm_scale is not None:
+                    x = x.astype(jnp.float32) * self._norm_scale + self._norm_bias
+                shape = (cohort, cfg.local_steps, cfg.batch_size)
+                x = x.reshape(
+                    shape + (self._sample_shape if self._spatial_input else (-1,))
+                )
+                y = y_train[idx].reshape(shape)
+                chunk = self._constrain_stack(
+                    self._client_stack(flat_params, x, y, mask_c)
+                )
+
+                if self.attack is not None and self.attack.message_fn is not None:
+                    # cohort purity: byz chunks are the LAST ones, so byz_size =
+                    # cohort attacks the whole chunk and the scalar gate keeps
+                    # honest chunks untouched (row-local attacks only —
+                    # cfg.validate rejects the omniscient ones)
+                    is_byz_chunk = c_idx >= n_h_chunks
+                    d_view = None
+                    if self.attack.defense_aware:
+                        # chunk-local slice of the PREVIOUS iteration's
+                        # published detector rows.  MUST read the iteration-
+                        # start snapshot, not ``defense_state``: that variable
+                        # is rebound (step+1, new rung) after the observation
+                        # scan but BEFORE the aggregation pass re-invokes this
+                        # closure, and a post-update view would make the two
+                        # passes rebuild different chunks (and break resident
+                        # parity at the attack's schedule boundaries)
+                        det_s, pol_s = defense_state_in
+                        step_s, ema_s, dev_s, cus_s = det_s
+                        if cfg.service == "on":
+                            ids_v = jax.lax.dynamic_slice_in_dim(
+                                pop_ids, off, cohort
+                            )
+                            ema_v, dev_v, cus_v = (
+                                ema_s[ids_v], dev_s[ids_v], cus_s[ids_v]
+                            )
+                        else:
+                            ema_v, dev_v, cus_v = (
+                                jax.lax.dynamic_slice_in_dim(r, off, cohort)
+                                for r in (ema_s, dev_s, cus_s)
+                            )
+                        d_view = attack_lib.DefenseView(
+                            step=step_s,
+                            ema=ema_v,
+                            dev=dev_v,
+                            cusum=cus_v,
+                            rung=pol_s[0],
+                            detector=self.defense.detector,
+                            policy=self.defense.policy,
+                            guess=flat_params,
+                        )
+                    w_att = self.attack.apply_message(
+                        chunk, cohort, channel_lib.cohort_key(k_msg, c_idx),
+                        param=cfg.attack_param, defense=d_view,
+                    )
+                    gate = (
+                        is_byz_chunk if attack_on is None
+                        else jnp.logical_and(is_byz_chunk, attack_on)
+                    )
+                    chunk = jnp.where(gate, w_att, chunk)
+
+                ge_c = ()
+                n_erased = n_corrupt = jnp.float32(0.0)
+                if self.fault is not None:
+                    ge_in = (
+                        jax.lax.dynamic_slice_in_dim(ge_bad, off, cohort)
+                        if self.fault.needs_ge
+                        else ()
+                    )
+                    chunk, ge_c, n_erased, n_corrupt = (
+                        fault_lib.apply_transmission(
+                            self.fault, channel_lib.cohort_key(k_trans, c_idx),
+                            chunk, ge_in, row_offset=off,
+                        )
+                    )
+
+                if cfg.noise_var is not None and agg_lib.needs_oma_prepass(
+                    cfg.agg
+                ):
                     if cfg.service == "on":
-                        ids_v = jax.lax.dynamic_slice_in_dim(
+                        # per-STABLE-ID links under the ROUND key (not the
+                        # cohort fold-in): fold_in(k_chan, id) is invariant to
+                        # which chunk the draw placed a client in, so the
+                        # streamed realization matches the resident path's
+                        # bit for bit
+                        ids_c = jax.lax.dynamic_slice_in_dim(
                             pop_ids, off, cohort
                         )
-                        ema_v, dev_v, cus_v = (
-                            ema_s[ids_v], dev_s[ids_v], cus_s[ids_v]
+                        chunk = channel_lib.oma_by_id(
+                            k_chan, chunk, ids_c, cfg.noise_var
                         )
                     else:
-                        ema_v, dev_v, cus_v = (
-                            jax.lax.dynamic_slice_in_dim(r, off, cohort)
-                            for r in (ema_s, dev_s, cus_s)
+                        chunk = channel_lib.oma(
+                            channel_lib.cohort_key(k_chan, c_idx), chunk,
+                            cfg.noise_var,
                         )
-                    d_view = attack_lib.DefenseView(
-                        step=step_s,
-                        ema=ema_v,
-                        dev=dev_v,
-                        cusum=cus_v,
-                        rung=pol_s[0],
-                        detector=self.defense.detector,
-                        policy=self.defense.policy,
-                        guess=flat_params,
-                    )
-                w_att = self.attack.apply_message(
-                    chunk, cohort, channel_lib.cohort_key(k_msg, c_idx),
-                    param=cfg.attack_param, defense=d_view,
-                )
-                gate = (
-                    is_byz_chunk if attack_on is None
-                    else jnp.logical_and(is_byz_chunk, attack_on)
-                )
-                chunk = jnp.where(gate, w_att, chunk)
-
-            ge_c = ()
-            n_erased = n_corrupt = jnp.float32(0.0)
-            if self.fault is not None:
-                ge_in = (
-                    jax.lax.dynamic_slice_in_dim(ge_bad, off, cohort)
-                    if self.fault.needs_ge
-                    else ()
-                )
-                chunk, ge_c, n_erased, n_corrupt = (
-                    fault_lib.apply_transmission(
-                        self.fault, channel_lib.cohort_key(k_trans, c_idx),
-                        chunk, ge_in, row_offset=off,
-                    )
-                )
-
-            if cfg.noise_var is not None and agg_lib.needs_oma_prepass(
-                cfg.agg
-            ):
+                chunk = self._constrain_stack(chunk)
                 if cfg.service == "on":
-                    # per-STABLE-ID links under the ROUND key (not the
-                    # cohort fold-in): fold_in(k_chan, id) is invariant to
-                    # which chunk the draw placed a client in, so the
-                    # streamed realization matches the resident path's
-                    # bit for bit
-                    ids_c = jax.lax.dynamic_slice_in_dim(
-                        pop_ids, off, cohort
+                    # deadline erasure LAST (as in the resident path), sliced
+                    # from the resident [K] mask so every rebuild pass sees
+                    # identical chunks
+                    miss_c = jax.lax.dynamic_slice_in_dim(missed, off, cohort)
+                    chunk = jnp.where(
+                        miss_c[:, None], jnp.asarray(jnp.nan, chunk.dtype), chunk
                     )
-                    chunk = channel_lib.oma_by_id(
-                        k_chan, chunk, ids_c, cfg.noise_var
-                    )
-                else:
-                    chunk = channel_lib.oma(
-                        channel_lib.cohort_key(k_chan, c_idx), chunk,
-                        cfg.noise_var,
-                    )
-            chunk = self._constrain_stack(chunk)
-            if cfg.service == "on":
-                # deadline erasure LAST (as in the resident path), sliced
-                # from the resident [K] mask so every rebuild pass sees
-                # identical chunks
-                miss_c = jax.lax.dynamic_slice_in_dim(missed, off, cohort)
-                chunk = jnp.where(
-                    miss_c[:, None], jnp.asarray(jnp.nan, chunk.dtype), chunk
-                )
-            return chunk, ge_c, n_erased, n_corrupt
+                return chunk, ge_c, n_erased, n_corrupt
 
-        def rebuild(c_idx):
-            return rebuild_full(c_idx)[0]
+            def rebuild(c_idx):
+                return rebuild_full(c_idx)[0]
 
-        # ---- single observation pass over the chunks
-        needs_ge = self.fault is not None and self.fault.needs_ge
-        if self.defense is not None:
-            det, pol = defense_state
-        obs_init = (
-            jnp.zeros(d, jnp.float32),   # sum over all rows
-            jnp.zeros(d, jnp.float32),   # sum over finite rows
-            jnp.int32(0),                # finite-row count
-            jnp.zeros(d, jnp.float32),   # honest-row sum (dispersion)
-            jnp.float32(0.0),            # honest sum of squared norms
-            jnp.float32(0.0) if cfg.service == "on" else (),  # honest fin
-            ge_bad if needs_ge else (),
-            jnp.float32(0.0),            # erased
-            jnp.float32(0.0),            # corrupt
-            (det[1], det[2], det[3]) if self.defense is not None else (),
-            jnp.int32(0) if self.defense is not None else (),
-            jnp.float32(0.0) if self.defense is not None else (),
-            # running top-M forensic candidates ([M, NUM_COLS], score
-            # column seeded -inf so real rows displace the sentinels)
-            forensics_lib.stream_init(cfg.forensics_top)
-            if self._forensics_on else (),
-        )
-
-        def obs_body(carry_o, c_idx):
-            (
-                s_all, s_fin, n_fin, s_h, ssq_h, n_h_fin, ge_acc, n_er,
-                n_co, det_rows, n_flag, max_sc, topm,
-            ) = carry_o
-            chunk, ge_c, er, co = rebuild_full(c_idx)
-            fin = agg_lib._finite_rows(chunk)
-            c32 = chunk.astype(jnp.float32)
-            c_fin = jnp.where(fin[:, None], c32, 0.0)
-            s_all = s_all + jnp.sum(c32, axis=0)
-            s_fin = s_fin + jnp.sum(c_fin, axis=0)
-            n_fin = n_fin + jnp.sum(fin)
-            is_h = (c_idx < n_h_chunks).astype(jnp.float32)
-            if cfg.service == "on":
-                # deadline-missed honest rows are NaN: the dispersion
-                # moments run over what the round actually received
-                s_h = s_h + is_h * jnp.sum(c_fin, axis=0)
-                ssq_h = ssq_h + is_h * jnp.sum(c_fin * c_fin)
-                n_h_fin = n_h_fin + is_h * jnp.sum(fin).astype(jnp.float32)
-            else:
-                s_h = s_h + is_h * jnp.sum(c32, axis=0)
-                ssq_h = ssq_h + is_h * jnp.sum(c32 * c32)
-            if self.fault is not None:
-                n_er, n_co = n_er + er, n_co + co
-                if needs_ge:
-                    ge_acc = jax.lax.dynamic_update_slice_in_dim(
-                        ge_acc, ge_c, c_idx * cohort, axis=0
-                    )
+            # ---- single observation pass over the chunks (per shard)
             if self.defense is not None:
-                # per-client detector rows, updated slice-by-slice under
-                # the shared scalar step (incremented ONCE after the scan)
-                ema, dev, cus = det_rows
-                off = c_idx * cohort
-                # component-returning variant (defense/scores.py): same
-                # score/finite values; the component columns are dead code
-                # when forensics is off
-                score, score_fin, score_parts = (
-                    defense_lib.client_score_components(chunk, flat_params)
+                det, pol = defense_state_in
+                det_rows0 = (det[1], det[2], det[3])
+                if sharded:
+                    # extra touched-row mask: the scan scatters True at
+                    # this shard's drawn rows so the post-scan merge can
+                    # select each shard's disjoint row updates
+                    det_rows0 = det_rows0 + (jnp.zeros(det[1].shape, bool),)
+            else:
+                det_rows0 = ()
+            obs_init = (
+                jnp.zeros(d, jnp.float32),   # sum over all rows
+                jnp.zeros(d, jnp.float32),   # sum over finite rows
+                jnp.int32(0),                # finite-row count
+                jnp.zeros(d, jnp.float32),   # honest-row sum (dispersion)
+                jnp.float32(0.0),            # honest sum of squared norms
+                jnp.float32(0.0) if cfg.service == "on" else (),  # honest fin
+                ge_bad if needs_ge else (),
+                jnp.float32(0.0),            # erased
+                jnp.float32(0.0),            # corrupt
+                det_rows0,
+                jnp.int32(0) if self.defense is not None else (),
+                jnp.float32(0.0) if self.defense is not None else (),
+                # running top-M forensic candidates ([M, NUM_COLS], score
+                # column seeded -inf so real rows displace the sentinels)
+                forensics_lib.stream_init(cfg.forensics_top)
+                if self._forensics_on else (),
+            )
+
+            def obs_body(carry_o, c_idx):
+                (
+                    s_all, s_fin, n_fin, s_h, ssq_h, n_h_fin, ge_acc, n_er,
+                    n_co, det_rows, n_flag, max_sc, topm,
+                ) = carry_o
+                chunk, ge_c, er, co = rebuild_full(c_idx)
+                fin = agg_lib._finite_rows(chunk)
+                c32 = chunk.astype(jnp.float32)
+                c_fin = jnp.where(fin[:, None], c32, 0.0)
+                s_all = s_all + jnp.sum(c32, axis=0)
+                s_fin = s_fin + jnp.sum(c_fin, axis=0)
+                n_fin = n_fin + jnp.sum(fin)
+                is_h = (c_idx < n_h_chunks).astype(jnp.float32)
+                if cfg.service == "on":
+                    # deadline-missed honest rows are NaN: the dispersion
+                    # moments run over what the round actually received
+                    s_h = s_h + is_h * jnp.sum(c_fin, axis=0)
+                    ssq_h = ssq_h + is_h * jnp.sum(c_fin * c_fin)
+                    n_h_fin = n_h_fin + is_h * jnp.sum(fin).astype(jnp.float32)
+                else:
+                    s_h = s_h + is_h * jnp.sum(c32, axis=0)
+                    ssq_h = ssq_h + is_h * jnp.sum(c32 * c32)
+                if self.fault is not None:
+                    n_er, n_co = n_er + er, n_co + co
+                    if needs_ge:
+                        ge_acc = jax.lax.dynamic_update_slice_in_dim(
+                            ge_acc, ge_c, c_idx * cohort, axis=0
+                        )
+                if self.defense is not None:
+                    # per-client detector rows, updated slice-by-slice under
+                    # the shared scalar step (incremented ONCE after the scan)
+                    if sharded:
+                        ema, dev, cus, touched = det_rows
+                    else:
+                        ema, dev, cus = det_rows
+                    off = c_idx * cohort
+                    # component-returning variant (defense/scores.py): same
+                    # score/finite values; the component columns are dead code
+                    # when forensics is off
+                    score, score_fin, score_parts = (
+                        defense_lib.client_score_components(chunk, flat_params)
+                    )
+                    if cfg.service == "on":
+                        # population-keyed rows: gather this chunk's drawn ids,
+                        # update under their own first-observation markers
+                        # (dev == 0 <=> never updated), scatter back — same
+                        # contract as the resident service path
+                        rows_c = jax.lax.dynamic_slice_in_dim(
+                            pop_ids, off, cohort
+                        )
+                        det_c = (det[0], ema[rows_c], dev[rows_c], cus[rows_c])
+                        (_, ema_c, dev_c, cus_c), flags = (
+                            defense_lib.detector_update(
+                                det_c, score, score_fin, self.defense.detector,
+                                first=det_c[2] == 0.0,
+                            )
+                        )
+                        det_rows = (
+                            ema.at[rows_c].set(ema_c),
+                            dev.at[rows_c].set(dev_c),
+                            cus.at[rows_c].set(cus_c),
+                        )
+                        if sharded:
+                            det_rows = det_rows + (
+                                touched.at[rows_c].set(True),
+                            )
+                    else:
+                        det_c = (
+                            det[0],
+                            jax.lax.dynamic_slice_in_dim(ema, off, cohort),
+                            jax.lax.dynamic_slice_in_dim(dev, off, cohort),
+                            jax.lax.dynamic_slice_in_dim(cus, off, cohort),
+                        )
+                        (_, ema_c, dev_c, cus_c), flags = (
+                            defense_lib.detector_update(
+                                det_c, score, score_fin, self.defense.detector
+                            )
+                        )
+                        det_rows = (
+                            jax.lax.dynamic_update_slice_in_dim(
+                                ema, ema_c, off, axis=0
+                            ),
+                            jax.lax.dynamic_update_slice_in_dim(
+                                dev, dev_c, off, axis=0
+                            ),
+                            jax.lax.dynamic_update_slice_in_dim(
+                                cus, cus_c, off, axis=0
+                            ),
+                        )
+                    n_flag = n_flag + jnp.sum(flags)
+                    max_sc = jnp.maximum(max_sc, jnp.max(score))
+                    if self._forensics_on:
+                        # per-cohort top-M merge: this chunk's candidates
+                        # (stable ids under service, participant rows
+                        # otherwise; pre-update ema/dev, post-update CUSUM)
+                        # against the carried top-M — fixed [M, NUM_COLS]
+                        ids_f = (
+                            rows_c if cfg.service == "on"
+                            else off + jnp.arange(cohort, dtype=jnp.int32)
+                        )
+                        topm = forensics_lib.merge_top_m(
+                            topm,
+                            forensics_lib.candidate_rows(
+                                ids_f, score, score_parts, det_c[1], det_c[2],
+                                cus_c, flags, self.defense.detector,
+                            ),
+                            cfg.forensics_top,
+                        )
+                return (
+                    s_all, s_fin, n_fin, s_h, ssq_h, n_h_fin, ge_acc, n_er,
+                    n_co, det_rows, n_flag, max_sc, topm,
+                )
+
+            # per-leaf merge tags (ops/shardctx.py): integer sums and
+            # extrema are placement-exact; float sums fold in canonical
+            # shard order; detector rows stack for the disjoint-row merge
+            # below.  LOCAL ignores the spec and lowers to the legacy
+            # single lax.scan.
+            obs_spec = (
+                "sum", "sum", "sum", "sum", "sum",
+                "sum" if cfg.service == "on" else (),
+                "stack" if needs_ge else (),
+                "sum", "sum",
+                ("stack",) * (4 if sharded else 3)
+                if self.defense is not None else (),
+                "sum" if self.defense is not None else (),
+                "max" if self.defense is not None else (),
+                "stack" if self._forensics_on else (),
+            )
+            with jax.named_scope("stream_observe"):
+                (
+                    s_all, s_fin, n_fin, s_h, ssq_h, n_h_fin, ge_new, n_er,
+                    n_co, det_rows, n_flag, max_sc, topm,
+                ) = ctx.scan_idx_merge(n_chunks, obs_body, obs_init, obs_spec)
+
+            defense_state_new = ()
+            defense_metrics = ()
+            forensic = ()
+            rung = None
+            if self.defense is not None:
+                if sharded:
+                    # disjoint-row merge of the stacked [S, population]
+                    # detector partials: the stratified draw is WITHOUT
+                    # replacement, so every drawn id lives in exactly one
+                    # chunk — shard p's touched rows never overlap shard
+                    # q's, and untouched rows keep their round-start value
+                    ema_s, dev_s, cus_s, touched_s = det_rows
+                    ema_m, dev_m, cus_m = det[1], det[2], det[3]
+                    for p_i in range(ctx.n_shards):
+                        t_p = touched_s[p_i]
+                        ema_m = jnp.where(t_p, ema_s[p_i], ema_m)
+                        dev_m = jnp.where(t_p, dev_s[p_i], dev_m)
+                        cus_m = jnp.where(t_p, cus_s[p_i], cus_m)
+                    det_rows = (ema_m, dev_m, cus_m)
+                det = (det[0] + 1, det_rows[0], det_rows[1], det_rows[2])
+                pol, suspicious = defense_lib.policy_update(
+                    pol, n_flag, self.defense.policy
+                )
+                rung = pol[0]
+                defense_state_new = (det, pol)
+                defense_metrics = jnp.stack([
+                    rung.astype(jnp.float32),
+                    n_flag.astype(jnp.float32),
+                    suspicious.astype(jnp.float32),
+                    max_sc,
+                    jnp.max(det[3]),
+                ])
+                if self._forensics_on:
+                    # rung at flag time, stamped once the policy has updated
+                    forensic = forensics_lib.with_rung(topm, rung)
+
+            with jax.named_scope("stream_aggregate"):
+                kw = dict(
+                    k=k_total, d=d, n_chunks=n_chunks,
+                    degraded=self.fault is not None or cfg.service == "on",
+                    sum_all=s_all, sum_finite=s_fin, n_finite=n_fin,
+                    guess=flat_params, maxiter=cfg.agg_maxiter,
+                    tol=cfg.agg_tol, quantile=cfg.cohort_quantile,
+                    sketch_bins=cfg.cohort_sketch_bins, ctx=ctx,
                 )
                 if cfg.service == "on":
-                    # population-keyed rows: gather this chunk's drawn ids,
-                    # update under their own first-observation markers
-                    # (dev == 0 <=> never updated), scatter back — same
-                    # contract as the resident service path
-                    rows_c = jax.lax.dynamic_slice_in_dim(
-                        pop_ids, off, cohort
+                    # rollback-widened trim fraction — only the streamed
+                    # trimmed_mean's dynamic trim budget consumes it
+                    kw["trim_ratio"] = jnp.minimum(
+                        jnp.float32(0.1) * widen, 0.45
                     )
-                    det_c = (det[0], ema[rows_c], dev[rows_c], cus[rows_c])
-                    (_, ema_c, dev_c, cus_c), flags = (
-                        defense_lib.detector_update(
-                            det_c, score, score_fin, self.defense.detector,
-                            first=det_c[2] == 0.0,
-                        )
+                if self.defense is not None and self.defense.mode == "adaptive":
+                    # streamed rung dispatch: one lax.switch over nullary
+                    # streamed closures (cfg.validate pins every rung to a
+                    # streamable aggregator)
+                    branches = tuple(
+                        (lambda nm: lambda: agg_lib.stream_aggregate(
+                            nm, rebuild, **kw
+                        ))(nm)
+                        for nm in self.defense.ladder
                     )
-                    det_rows = (
-                        ema.at[rows_c].set(ema_c),
-                        dev.at[rows_c].set(dev_c),
-                        cus.at[rows_c].set(cus_c),
-                    )
+                    aggregated = jax.lax.switch(rung, branches)
                 else:
-                    det_c = (
-                        det[0],
-                        jax.lax.dynamic_slice_in_dim(ema, off, cohort),
-                        jax.lax.dynamic_slice_in_dim(dev, off, cohort),
-                        jax.lax.dynamic_slice_in_dim(cus, off, cohort),
-                    )
-                    (_, ema_c, dev_c, cus_c), flags = (
-                        defense_lib.detector_update(
-                            det_c, score, score_fin, self.defense.detector
-                        )
-                    )
-                    det_rows = (
-                        jax.lax.dynamic_update_slice_in_dim(
-                            ema, ema_c, off, axis=0
-                        ),
-                        jax.lax.dynamic_update_slice_in_dim(
-                            dev, dev_c, off, axis=0
-                        ),
-                        jax.lax.dynamic_update_slice_in_dim(
-                            cus, cus_c, off, axis=0
-                        ),
-                    )
-                n_flag = n_flag + jnp.sum(flags)
-                max_sc = jnp.maximum(max_sc, jnp.max(score))
-                if self._forensics_on:
-                    # per-cohort top-M merge: this chunk's candidates
-                    # (stable ids under service, participant rows
-                    # otherwise; pre-update ema/dev, post-update CUSUM)
-                    # against the carried top-M — fixed [M, NUM_COLS]
-                    ids_f = (
-                        rows_c if cfg.service == "on"
-                        else off + jnp.arange(cohort, dtype=jnp.int32)
-                    )
-                    topm = forensics_lib.merge_top_m(
-                        topm,
-                        forensics_lib.candidate_rows(
-                            ids_f, score, score_parts, det_c[1], det_c[2],
-                            cus_c, flags, self.defense.detector,
-                        ),
-                        cfg.forensics_top,
-                    )
+                    aggregated = agg_lib.stream_aggregate(cfg.agg, rebuild, **kw)
+                aggregated = aggregated.astype(jnp.float32)
             return (
-                s_all, s_fin, n_fin, s_h, ssq_h, n_h_fin, ge_acc, n_er,
-                n_co, det_rows, n_flag, max_sc, topm,
-            ), None
-
-        with jax.named_scope("stream_observe"):
-            (
-                s_all, s_fin, n_fin, s_h, ssq_h, n_h_fin, ge_new, n_er,
-                n_co, det_rows, n_flag, max_sc, topm,
-            ), _ = jax.lax.scan(
-                obs_body, obs_init, jnp.arange(n_chunks, dtype=jnp.int32)
+                aggregated, n_fin, s_h, ssq_h, n_h_fin,
+                ge_new if needs_ge else (), n_er, n_co,
+                defense_state_new, defense_metrics, forensic,
             )
+
+        (
+            aggregated, n_fin, s_h, ssq_h, n_h_fin, ge_new, n_er, n_co,
+            defense_state_new, defense_metrics, forensic,
+        ) = self._pop_shard_region(core, region_in)
         if self.fault is not None:
             fault_state = (stale, ge_new if needs_ge else ge_bad)
-
-        defense_metrics = ()
-        forensic = ()
-        rung = None
         if self.defense is not None:
-            det = (det[0] + 1, det_rows[0], det_rows[1], det_rows[2])
-            pol, suspicious = defense_lib.policy_update(
-                pol, n_flag, self.defense.policy
-            )
-            rung = pol[0]
-            defense_state = (det, pol)
-            defense_metrics = jnp.stack([
-                rung.astype(jnp.float32),
-                n_flag.astype(jnp.float32),
-                suspicious.astype(jnp.float32),
-                max_sc,
-                jnp.max(det[3]),
-            ])
-            if self._forensics_on:
-                # rung at flag time, stamped once the policy has updated
-                forensic = forensics_lib.with_rung(topm, rung)
+            defense_state = defense_state_new
 
         with jax.named_scope("stream_aggregate"):
-            kw = dict(
-                k=k_total, d=d, n_chunks=n_chunks,
-                degraded=self.fault is not None or cfg.service == "on",
-                sum_all=s_all, sum_finite=s_fin, n_finite=n_fin,
-                guess=flat_params, maxiter=cfg.agg_maxiter,
-                tol=cfg.agg_tol, quantile=cfg.cohort_quantile,
-                sketch_bins=cfg.cohort_sketch_bins,
-            )
-            if cfg.service == "on":
-                # rollback-widened trim fraction — only the streamed
-                # trimmed_mean's dynamic trim budget consumes it
-                kw["trim_ratio"] = jnp.minimum(
-                    jnp.float32(0.1) * widen, 0.45
-                )
-            if self.defense is not None and self.defense.mode == "adaptive":
-                # streamed rung dispatch: one lax.switch over nullary
-                # streamed closures (cfg.validate pins every rung to a
-                # streamable aggregator)
-                branches = tuple(
-                    (lambda nm: lambda: agg_lib.stream_aggregate(
-                        nm, rebuild, **kw
-                    ))(nm)
-                    for nm in self.defense.ladder
-                )
-                aggregated = jax.lax.switch(rung, branches)
-            else:
-                aggregated = agg_lib.stream_aggregate(cfg.agg, rebuild, **kw)
-            aggregated = aggregated.astype(jnp.float32)
             if self.fault is not None or cfg.service == "on":
                 # same receiver-side finite-guard as the resident path
                 aggregated = jnp.where(
@@ -2120,10 +2257,16 @@ class FedTrainer:
                     recent_val.pop(0)
                 # snapshot BEFORE checkpoint_fn: a corrupting checkpoint
                 # hook (tests force divergence through it) must not be able
-                # to poison the restore point
+                # to poison the restore point.  copy=True is load-bearing:
+                # np.asarray of a CPU jax array can be a zero-copy VIEW of
+                # the device buffer, and every carry slot is DONATED to the
+                # next round's call — the allocator reuses the memory under
+                # the view and the "snapshot" silently rots (observed as
+                # garbage restores under the pop-mesh engine, whose extra
+                # collective buffers change the reuse pattern)
                 state = _state_tuple()
                 snapshot = (
-                    jax.tree.map(np.asarray, state),
+                    jax.tree.map(lambda x: np.array(x, copy=True), state),
                     jax.tree.map(lambda x: x.sharding, state),
                     r + 1,
                 )

@@ -23,6 +23,16 @@ Sinks additionally stamp a fourth envelope key at emission time:
   before emission legitimately lack it; ``validate_event`` treats it as
   optional.
 
+A fourth reserved key is stamped at ``make_event`` time:
+
+* ``host_id`` — the emitting process's ``jax.process_index()`` (0 when
+  jax is absent, uninitialized, or single-process).  ``seq`` is only
+  per-SINK monotonic; on a multi-host population mesh each process
+  appends its own stream, and the analysis loaders merge them into one
+  total order by ``(host_id, seq)`` (``analysis/obs_report.py``,
+  ``analysis/tail.py``).  Old v<5 streams lack the key; loaders default
+  it to 0.
+
 The per-round ``round`` event mirrors — field for field — the reference
 pickled record the harness still writes (bitwise untouched; the event
 stream is written ALONGSIDE it).  :data:`REFERENCE_KEY_MAP` is the
@@ -47,7 +57,12 @@ from typing import Any, Dict, Optional
 # ``run_cancelled`` / ``knob_swap`` (serve/runs.py control-plane audit
 # trail — every tenant-visible state change lands in the run's own
 # event stream).
-SCHEMA_VERSION = 4
+# v5: added the ``host_id`` envelope key (``jax.process_index()`` at
+# emission, 0 off-mesh) so multi-host population-sharded runs whose
+# processes each append their own stream can be merged into one total
+# order by ``(host_id, seq)`` — ``seq`` alone is only per-sink monotonic,
+# and two hosts' sinks both start at 0.
+SCHEMA_VERSION = 5
 
 # round-event field -> reference pickled-record key it mirrors
 # (round r's event carries metrics the record stores at index r+1 for the
@@ -117,9 +132,27 @@ _REQUIRED: Dict[str, tuple] = {
 }
 
 
+def _host_id() -> int:
+    """The emitting process's mesh rank — 0 unless a multi-process jax
+    runtime is up.  Resolved lazily per event (not at import) so a late
+    ``parallel.multihost.initialize`` is still reflected, and guarded so
+    event emission never depends on jax being importable."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
 def make_event(kind: str, **fields: Any) -> Dict[str, Any]:
     """Stamp ``fields`` into a schema-versioned event dict."""
-    event: Dict[str, Any] = {"v": SCHEMA_VERSION, "kind": kind, "ts": time.time()}
+    event: Dict[str, Any] = {
+        "v": SCHEMA_VERSION,
+        "kind": kind,
+        "ts": time.time(),
+        "host_id": _host_id(),
+    }
     event.update(fields)
     return event
 

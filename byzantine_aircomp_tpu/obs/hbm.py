@@ -147,6 +147,7 @@ def streamed_peak_bytes(
     chunk_copies: int = 3,
     param_copies: int = 6,
     state_bytes_per_client: int = 0,
+    pop_shards: int = 1,
 ) -> int:
     """Peak-allocation model for the COHORT-STREAMED round program
     (``--cohort-size > 0``) — the counterpart of :func:`modeled_peak_bytes`
@@ -166,15 +167,33 @@ def streamed_peak_bytes(
 
     Peak scales as O(cohort*d + d + K), never O(K*d): the quantity the
     K-sweep acceptance demo and the harness watermark cross-check read.
+
+    ``pop_shards > 1`` turns this into the PER-HOST budget under the
+    population mesh (``parallel/popmesh.py``).  The mesh divides the
+    wall-clock chunk count, not the buffers: each owner scans its own
+    chunk range with the same chunk/param/state working set because the
+    carry is replicated rather than partitioned.  What sharding ADDS per
+    host is the merge transient — one shard-ordered ``all_gather`` stacks
+    the S per-shard partial carries (the [d] float accumulators and the
+    per-client state rows) before the canonical fold — so those terms
+    exist S-fold for the fold's lifetime.  The int-summed leaves (rank
+    counts, sketch histograms, vote planes) merge by ``psum`` and never
+    stack.  The result must be compared against the PER-DEVICE watermark
+    (``obs/profile.py per_device_memory``), never a mesh-wide total.
     """
     chunk = cohort * d * dtype_bytes
     params = d * dtype_bytes
-    return (
+    peak = (
         chunk_copies * chunk
         + param_copies * params
         + state_bytes_per_client * k
         + data_bytes
     )
+    if pop_shards > 1:
+        peak += (pop_shards - 1) * (
+            param_copies * params + state_bytes_per_client * k
+        )
+    return peak
 
 
 def modeled_peak_bytes(

@@ -41,7 +41,8 @@ DEFAULT_LEDGER_PATH = "docs/perf_ledger.jsonl"
 #: row fields that define a comparable configuration (sorted into ``key``);
 #: deliberately excludes output-only knobs and per-run facts (timed_rounds,
 #: ts, value) — mirrors the config_hash philosophy at bench granularity
-CONFIG_KEY_FIELDS = ("k", "b", "agg", "attack", "dataset", "model")
+CONFIG_KEY_FIELDS = ("k", "b", "agg", "attack", "dataset", "model",
+                     "pop_shards")
 
 #: descriptive row fields worth carrying INTO the ledger when present —
 #: not part of the config key, but they make a row self-describing (the
@@ -61,6 +62,12 @@ LEDGER_EXTRA_FIELDS = (
     "bytes_moved",
     "bytes_moved_f32",
     "sign_bits",
+    # service-mode stream_ksweep rows (BENCH_KSWEEP_SERVICE): rows record
+    # k = population (the id space the round draws from), and carry the
+    # per-host streamed model from obs/hbm.py when the round ran sharded
+    # over the population mesh (pop_shards > 1 is part of the config key)
+    "population",
+    "peak_per_host_modeled_bytes",
 )
 
 #: relative band half-width tolerated as noise (±10%)

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from ..registry import AGGREGATORS
 from . import channel
 from . import pallas_kernels
+from . import shardctx
 from .pallas_kernels import DIST_CLAMP, GM_THRESHOLD_FACTOR
 
 
@@ -1272,6 +1273,16 @@ def gm(
 # Compute trades for memory: P passes re-run the cohort rebuild (client
 # local steps included) P times.  docs/DESIGN.md "Streamed rounds" has the
 # carry layouts and the per-aggregator mergeability argument.
+#
+# Every pass below runs through a population-shard context
+# (``ops/shardctx.py``): the default ``shardctx.LOCAL`` scans all chunks in
+# one ``lax.scan`` (byte-identical to the pre-sharding programs), while the
+# sequential and mesh engines scan per-shard chunk ranges and merge the
+# partial carries under the declared spec tags — integer counts by plain
+# addition (exact under any placement: a mesh ``psum`` IS the sequential
+# fold), float sums by a fixed left fold in shard order (both engines),
+# min/max leaves by their associative reductions.  docs/DESIGN.md
+# "Pod-scale service rounds" carries the per-aggregator merge algebra.
 
 
 def streamable(name: str) -> bool:
@@ -1296,7 +1307,7 @@ def _chunk_scan(rebuild, n_chunks: int, body, init):
     return carry
 
 
-def stream_stats(rebuild, n_chunks: int, d: int):
+def stream_stats(rebuild, n_chunks: int, d: int, ctx=shardctx.LOCAL):
     """One pass: (sum over ALL rows [d], sum over finite rows [d],
     finite-row count) — the accumulators mean/gm2 need, exposed so the
     trainer's observation pass (which walks the chunks anyway) can supply
@@ -1312,16 +1323,21 @@ def stream_stats(rebuild, n_chunks: int, d: int):
             n_fin + jnp.sum(fin),
         )
 
-    return _chunk_scan(
+    return ctx.scan_merge(
         rebuild, n_chunks, acc,
         (jnp.zeros(d, jnp.float32), jnp.zeros(d, jnp.float32), jnp.int32(0)),
+        ("sum", "sum", "sum"),
     )
 
 
-def _stream_count_le(rebuild, n_chunks: int, degraded: bool):
+def _stream_count_le(rebuild, n_chunks: int, degraded: bool,
+                     ctx=shardctx.LOCAL):
     """count_le(mids [r, d] i32) -> [r, d] counts of total-order keys <=
     mid per column (finite rows only when degraded) — the one-pass
-    counting primitive under the streamed key bisection."""
+    counting primitive under the streamed key bisection.  The i32 counts
+    merge by plain addition across population shards (a mesh ``psum``),
+    so every bisection step — and hence the located rank keys — is
+    BIT-EQUAL under any shard placement."""
 
     def count_le(mids):
         r, d = mids.shape
@@ -1335,8 +1351,8 @@ def _stream_count_le(rebuild, n_chunks: int, degraded: bool):
                 le = jnp.logical_and(le, _finite_rows(chunk)[None, :, None])
             return cnt + jnp.sum(le, axis=1, dtype=jnp.int32)
 
-        return _chunk_scan(
-            rebuild, n_chunks, acc, jnp.zeros((r, d), jnp.int32)
+        return ctx.scan_merge(
+            rebuild, n_chunks, acc, jnp.zeros((r, d), jnp.int32), "sum"
         )
 
     return count_le
@@ -1362,7 +1378,7 @@ def _stream_bisect_keys(count_le, ns, r: int, d: int):
 
 
 def _stream_sketch_keys(rebuild, n_chunks: int, d: int, ns, r: int,
-                        bins: int, degraded: bool):
+                        bins: int, degraded: bool, ctx=shardctx.LOCAL):
     """Mergeable quantile sketch over total-order keys: one min/max pass,
     one [bins, d] histogram pass (per-cohort histograms merge by
     addition), then the requested ranks' bucket UPPER EDGES via the
@@ -1391,7 +1407,9 @@ def _stream_sketch_keys(rebuild, n_chunks: int, d: int, ns, r: int,
             jnp.maximum(kmax, jnp.max(hi_keys, axis=0)),
         )
 
-    kmin, kmax = _chunk_scan(rebuild, n_chunks, minmax, (kmin0, kmax0))
+    kmin, kmax = ctx.scan_merge(
+        rebuild, n_chunks, minmax, (kmin0, kmax0), ("min", "max")
+    )
     # bucket geometry in f32 (an int32 span overflows); the <= 2^-24
     # relative rounding is orders below the bucket width for bins << 2^24
     kminf = kmin.astype(jnp.float32)
@@ -1411,8 +1429,10 @@ def _stream_sketch_keys(rebuild, n_chunks: int, d: int, ns, r: int,
             ones[:, None]
         )
 
-    hist = _chunk_scan(
-        rebuild, n_chunks, hist_pass, jnp.zeros((bins, d), jnp.int32)
+    # per-shard [bins, d] histograms merge by i32 addition — the property
+    # that makes the sketch a valid streamed AND distributed summary
+    hist = ctx.scan_merge(
+        rebuild, n_chunks, hist_pass, jnp.zeros((bins, d), jnp.int32), "sum"
     )
     cum = jnp.cumsum(hist, axis=0)  # [bins, d]
     targets = jnp.reshape(jnp.asarray(ns, jnp.int32), (r, 1))
@@ -1425,7 +1445,7 @@ def _stream_sketch_keys(rebuild, n_chunks: int, d: int, ns, r: int,
 
 
 def _stream_trimmed_tail(rebuild, n_chunks: int, lo_k, hi_k, n, b,
-                         degraded: bool):
+                         degraded: bool, ctx=shardctx.LOCAL):
     """Final trimmed-mean pass given the kept band's boundary keys [d]:
     strict-interior sum plus boundary values times their kept multiplicity
     (the resident :func:`_select_trimmed_mean` rank-run formula), with the
@@ -1463,8 +1483,8 @@ def _stream_trimmed_tail(rebuild, n_chunks: int, lo_k, hi_k, n, b,
             le_hi + count(keys <= hi_k[None, :]),
         )
 
-    total, lt_lo, le_lo, lt_hi, le_hi = _chunk_scan(
-        rebuild, n_chunks, acc, init
+    total, lt_lo, le_lo, lt_hi, le_hi = ctx.scan_merge(
+        rebuild, n_chunks, acc, init, ("sum",) * 5
     )
     last = n - b - 1  # highest kept rank
 
@@ -1486,22 +1506,22 @@ def _stream_trimmed_tail(rebuild, n_chunks: int, lo_k, hi_k, n, b,
 
 
 def _stream_quantile_keys(rebuild, n_chunks, d, ns, r, *, quantile,
-                          sketch_bins, degraded):
+                          sketch_bins, degraded, ctx=shardctx.LOCAL):
     if quantile == "sketch":
         return _stream_sketch_keys(
-            rebuild, n_chunks, d, ns, r, sketch_bins, degraded
+            rebuild, n_chunks, d, ns, r, sketch_bins, degraded, ctx
         )
-    count_le = _stream_count_le(rebuild, n_chunks, degraded)
+    count_le = _stream_count_le(rebuild, n_chunks, degraded, ctx)
     return _stream_bisect_keys(count_le, ns, r, d)
 
 
 def stream_mean(rebuild, *, k, d, n_chunks, degraded=False, sum_all=None,
-                sum_finite=None, n_finite=None, **_):
+                sum_finite=None, n_finite=None, ctx=shardctx.LOCAL, **_):
     """Streamed :func:`mean`: exact up to chunk-sum reassociation.  The
     running sums normally arrive precomputed from the trainer's
     observation pass (0 extra rebuild passes)."""
     if sum_all is None or sum_finite is None or n_finite is None:
-        sum_all, sum_finite, n_finite = stream_stats(rebuild, n_chunks, d)
+        sum_all, sum_finite, n_finite = stream_stats(rebuild, n_chunks, d, ctx)
     if degraded:
         return jnp.where(
             n_finite > 0,
@@ -1513,13 +1533,17 @@ def stream_mean(rebuild, *, k, d, n_chunks, degraded=False, sum_all=None,
 
 def stream_gm2(rebuild, *, k, d, n_chunks, guess=None, maxiter=1000,
                tol=1e-5, degraded=False, sum_all=None, sum_finite=None,
-               n_finite=None, **_):
+               n_finite=None, ctx=shardctx.LOCAL, **_):
     """Streamed :func:`gm2`: each Weiszfeld step's num/den reductions
     accumulate over one chunk pass with the resident solver's exact
-    DIST_CLAMP / finite-mask / movement-stop semantics."""
+    DIST_CLAMP / finite-mask / movement-stop semantics.  Under a shard
+    context the per-shard (num, den) partials merge by the canonical
+    shard-order fold, so every engine walks the SAME guess sequence and
+    the while_loop's trip count agrees on every device — the collectives
+    inside the loop body stay aligned."""
     if guess is None:
         if sum_finite is None or n_finite is None:
-            _, sum_finite, n_finite = stream_stats(rebuild, n_chunks, d)
+            _, sum_finite, n_finite = stream_stats(rebuild, n_chunks, d, ctx)
         init_guess = sum_finite / jnp.maximum(n_finite, 1).astype(
             jnp.float32
         )
@@ -1544,9 +1568,10 @@ def stream_gm2(rebuild, *, k, d, n_chunks, guess=None, maxiter=1000,
             )
             return num, den + jnp.sum(inv)
 
-        num, den = _chunk_scan(
+        num, den = ctx.scan_merge(
             rebuild, n_chunks, acc,
             (jnp.zeros(d, jnp.float32), jnp.float32(0.0)),
+            ("sum", "sum"),
         )
         g_next = num / den
         movement = jnp.linalg.norm(g - g_next)
@@ -1559,13 +1584,17 @@ def stream_gm2(rebuild, *, k, d, n_chunks, guess=None, maxiter=1000,
 
 
 def stream_median(rebuild, *, k, d, n_chunks, degraded=False,
-                  n_finite=None, quantile="exact", sketch_bins=512, **_):
+                  n_finite=None, quantile="exact", sketch_bins=512,
+                  ctx=shardctx.LOCAL, **_):
     """Streamed :func:`median` (torch lower-middle semantics): locate the
     ``(n-1)//2`` rank key by bisection (exact — bit-equal to the resident
-    selection) or sketch, and bit-roundtrip it back to the value."""
+    selection) or sketch, and bit-roundtrip it back to the value.  Every
+    quantity here is integer-merged (rank counts, histograms, finite
+    counts), so the sharded result is bit-equal to the single-device one
+    for ANY pop_shards."""
     if degraded:
         if n_finite is None:
-            _, _, n_finite = stream_stats(rebuild, n_chunks, d)
+            _, _, n_finite = stream_stats(rebuild, n_chunks, d, ctx)
         n = n_finite
     else:
         n = k
@@ -1573,20 +1602,22 @@ def stream_median(rebuild, *, k, d, n_chunks, degraded=False,
     key = _stream_quantile_keys(
         rebuild, n_chunks, d, rank[None] if jnp.ndim(rank) == 0 else rank,
         1, quantile=quantile, sketch_bins=sketch_bins, degraded=degraded,
+        ctx=ctx,
     )
     return pallas_kernels.total_order_vals(key[0])
 
 
 def stream_trimmed_mean(rebuild, *, k, d, n_chunks, trim_ratio=0.1,
                         beta=None, degraded=False, n_finite=None,
-                        quantile="exact", sketch_bins=512, **_):
+                        quantile="exact", sketch_bins=512,
+                        ctx=shardctx.LOCAL, **_):
     """Streamed :func:`trimmed_mean`: kept-band boundary ranks by
     bisection/sketch, then one interior/boundary-multiplicity pass (the
     resident rank-run tie handling).  Degraded rounds adapt the trim
     budget to the finite-row count exactly like the resident sort path."""
     if degraded:
         if n_finite is None:
-            _, _, n_finite = stream_stats(rebuild, n_chunks, d)
+            _, _, n_finite = stream_stats(rebuild, n_chunks, d, ctx)
         n = jnp.asarray(n_finite, jnp.int32)
         if beta is None:
             b = (n.astype(jnp.float32) * trim_ratio).astype(jnp.int32)
@@ -1599,9 +1630,10 @@ def stream_trimmed_mean(rebuild, *, k, d, n_chunks, trim_ratio=0.1,
     keys = _stream_quantile_keys(
         rebuild, n_chunks, d, ns, 2,
         quantile=quantile, sketch_bins=sketch_bins, degraded=degraded,
+        ctx=ctx,
     )
     out = _stream_trimmed_tail(
-        rebuild, n_chunks, keys[0], keys[1], n, b, degraded
+        rebuild, n_chunks, keys[0], keys[1], n, b, degraded, ctx
     )
     if degraded:
         return jnp.where(n > 0, out, jnp.nan)
@@ -1623,8 +1655,10 @@ def stream_aggregate(name: str, rebuild, **kw):
     multi-pass algorithms call it once per pass and rely on every pass
     seeing identical chunks.  Keyword surface mirrors the resident
     aggregators (guess/maxiter/tol/trim/degraded) plus the streamed-only
-    knobs (n_chunks, quantile, sketch_bins, and the optional precomputed
-    observation-pass stats sum_all/sum_finite/n_finite)."""
+    knobs (n_chunks, quantile, sketch_bins, the optional precomputed
+    observation-pass stats sum_all/sum_finite/n_finite, and ``ctx`` — a
+    population-shard context from ``ops/shardctx.py`` under which every
+    chunk pass scans per-shard ranges and merges the partials)."""
     fn = AGGREGATORS.get(name)
     for stream_name, stream_fn in _STREAM_FNS.items():
         if fn is AGGREGATORS.get(stream_name):

@@ -1,0 +1,156 @@
+"""Population-shard execution contexts for the streamed cohort scans.
+
+``--pop-shards S`` splits a streamed service round's cohort chunks over S
+owners: shard ``p`` scans the GLOBAL chunk indices ``[p*cpp, (p+1)*cpp)``
+(``cpp = n_chunks // S``), and the per-shard partial carries are merged by
+a fixed algebra.  Three interchangeable engines realize the same program:
+
+* :data:`LOCAL` (S == 1) — today's single ``lax.scan`` over all chunks,
+  byte-identical to builds that predate pop-sharding;
+* :class:`SeqShardCtx` (S > 1, one device) — a ``lax.map`` over shard ids,
+  each running its own chunk scan, merged by an explicit LEFT FOLD in
+  shard order.  This is the sequential REFERENCE engine: it defines the
+  association order the mesh engine must reproduce bit-for-bit;
+* ``parallel.popmesh.MeshShardCtx`` (S > 1, a device mesh) — the same
+  per-shard scan inside ``shard_map``, merged by collectives.
+
+The merge algebra is declared per carry leaf with a SPEC tag:
+
+* ``"sum"``  — integer leaves merge by plain addition (associative and
+  commutative mod 2^32, so a mesh ``psum`` is EXACTLY the sequential
+  fold: rank counts, sketch histograms, finite counts, flag counts and
+  sign-vote plane sums are bit-equal under any placement).  Float leaves
+  are NOT reassociation-free, so both engines stack the S partials in
+  shard order and reduce them with the SAME left fold — the mesh engine
+  pays one all-gather of a [d]-sized partial instead of a psum to buy
+  bit-equality with the sequential engine.
+* ``"min"`` / ``"max"`` — associative/commutative order statistics
+  (sketch key ranges, max detector score): ``pmin``/``pmax`` == fold.
+* ``"stack"`` — no merge: the caller receives the [S, ...] per-shard
+  partials in shard order and owns the combine (the trainer's detector
+  rows merge by disjoint-row selection, which is not leafwise).
+
+Empty pytree leaves (``()``) pass through untouched, so feature-off
+carry slots cost nothing, exactly like the trainer's donated carry.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fold_leaves(parts, tag, n_shards: int):
+    """Merge one stacked [S, ...] partial leaf under its spec tag with the
+    canonical left fold.  Shared by the sequential engine and the mesh
+    engine's float-sum path, so the two produce bit-identical results."""
+    if tag == "stack":
+        return parts
+    if tag == "sum":
+        op = jnp.add
+    elif tag == "min":
+        op = jnp.minimum
+    elif tag == "max":
+        op = jnp.maximum
+    else:
+        raise ValueError(f"unknown shard merge tag {tag!r}")
+    out = parts[0]
+    for p in range(1, n_shards):
+        out = op(out, parts[p])
+    return out
+
+
+def _is_empty(x) -> bool:
+    return isinstance(x, tuple) and len(x) == 0
+
+
+def merge_spec_tree(spec, stacked, n_shards: int, merge_leaf):
+    """Apply ``merge_leaf(tag, parts)`` across a (spec, stacked-partials)
+    pytree pair, passing empty ``()`` slots through."""
+    return jax.tree.map(
+        lambda tag, parts: () if _is_empty(tag) else merge_leaf(tag, parts),
+        spec,
+        stacked,
+        is_leaf=_is_empty,
+    )
+
+
+class LocalShardCtx:
+    """S == 1: the legacy single-scan engine.  ``scan_idx_merge`` lowers to
+    exactly ``lax.scan(body, init, arange(n_chunks))`` — the spec is
+    ignored — so a ``pop_shards=1`` program traces byte-identically to
+    builds that predate pop-sharding."""
+
+    n_shards = 1
+
+    def varying(self, x):
+        """Mesh-engine hook (invarying -> device-varying promotion before
+        per-client grads); identity off-mesh."""
+        return x
+
+    def scan_idx_merge(self, n_chunks: int, body, init, spec=None):
+        def step(carry, c_idx):
+            return body(carry, c_idx), None
+
+        carry, _ = jax.lax.scan(
+            step, init, jnp.arange(n_chunks, dtype=jnp.int32)
+        )
+        return carry
+
+    def scan_merge(self, rebuild, n_chunks: int, body, init, spec=None):
+        return self.scan_idx_merge(
+            n_chunks, lambda carry, c: body(carry, rebuild(c), c), init, spec
+        )
+
+
+class SeqShardCtx:
+    """S > 1 on one device: the sequential reference engine.
+
+    Every shard's chunk scan runs under one ``lax.map`` over shard ids
+    (the body is traced once, not unrolled S times), and the stacked
+    partials merge with :func:`fold_leaves` — the association order the
+    mesh engine reproduces.  ``"sum"``-tagged INTEGER leaves make the
+    result independent of S entirely; float sums fork with S exactly the
+    way ``--cohort-size`` forks from the resident path (the config hash
+    carries ``pop_shards`` for the same reason)."""
+
+    def __init__(self, n_shards: int):
+        if n_shards < 2:
+            raise ValueError("SeqShardCtx wants n_shards >= 2; use LOCAL")
+        self.n_shards = n_shards
+
+    def varying(self, x):
+        return x
+
+    def scan_idx_merge(self, n_chunks: int, body, init, spec):
+        S = self.n_shards
+        if n_chunks % S:
+            raise ValueError(
+                f"n_chunks {n_chunks} not divisible by pop_shards {S}"
+            )
+        cpp = n_chunks // S
+
+        def one_shard(p):
+            idxs = p * cpp + jnp.arange(cpp, dtype=jnp.int32)
+
+            def step(carry, c_idx):
+                return body(carry, c_idx), None
+
+            carry, _ = jax.lax.scan(step, init, idxs)
+            return carry
+
+        stacked = jax.lax.map(one_shard, jnp.arange(S, dtype=jnp.int32))
+        return merge_spec_tree(
+            spec, stacked, S,
+            lambda tag, parts: fold_leaves(parts, tag, S),
+        )
+
+    def scan_merge(self, rebuild, n_chunks: int, body, init, spec):
+        return self.scan_idx_merge(
+            n_chunks, lambda carry, c: body(carry, rebuild(c), c), init, spec
+        )
+
+
+#: module-level singleton: the default context every streamed aggregator
+#: and the trainer's observation pass use when pop-sharding is off
+LOCAL = LocalShardCtx()

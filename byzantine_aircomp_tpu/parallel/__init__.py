@@ -1,2 +1,3 @@
-from . import collective, mesh, multihost  # noqa: F401
+from . import collective, mesh, multihost, popmesh  # noqa: F401
+from .popmesh import PopShardedFedTrainer  # noqa: F401
 from .sharded import ShardedFedTrainer  # noqa: F401

@@ -1,0 +1,210 @@
+"""Population-axis mesh engine for streamed service rounds (ISSUE 13).
+
+``ops/shardctx.py`` defines the merge algebra and the two off-mesh
+engines; this module supplies the third: :class:`MeshShardCtx` runs the
+trainer's streamed chunk region inside ``shard_map`` over a 1-D
+population mesh, each device scanning its own cohort-chunk range, with
+the per-shard partial carries merged by collectives —
+
+* integer/bool ``"sum"`` leaves by ``lax.psum`` (addition is associative
+  and commutative mod 2^32, so the collective is EXACTLY the sequential
+  fold: the median/trimmed-mean bisection's per-step rank counts, the
+  quantile-sketch histograms, finite/flag counts and the packed
+  sign-vote plane sums are bit-equal under any placement);
+* every other tagged leaf (float partial sums, min/max key ranges,
+  ``"stack"`` detector rows) by one ``lax.all_gather`` over the mesh
+  axis — stacked in shard order — followed by the SAME canonical left
+  fold the sequential engine uses (``shardctx.fold_leaves``), so the
+  mesh result is bit-identical to ``SeqShardCtx`` at the same
+  ``pop_shards`` by construction, not by accident of rounding.
+
+The merged values are identical on every device, so everything after a
+merge (the key-bisection guess updates, the gm2 Weiszfeld ``while_loop``
+trip counts, the defense policy rung, the ``lax.switch`` ladder branch)
+replicates deterministically and subsequent collectives stay aligned
+across the mesh — no divergent control flow, one lowering per host.
+
+``shard_map`` notes for this jaxlib: ``check_rep=False`` is required
+(the replication-inference pass cannot prove ``all_gather``-merged
+outputs replicated), and replicated ``in_specs=P()`` inputs are passed
+whole to every device — exactly the contract the trainer's region body
+expects (chunk ranges are selected by ``axis_index``, not by array
+sharding, because the chunk scan GATHERS from the replicated train set
+and index table rather than owning a slice of them).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..fed.train import FedTrainer
+from ..ops import aggregators as agg_lib
+from ..ops import shardctx
+
+POP_AXIS = "pop"
+
+
+def make_pop_mesh(n_shards: int, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D population mesh over the first ``n_shards`` devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_shards < 2:
+        raise ValueError(f"a population mesh wants >= 2 shards, got {n_shards}")
+    if len(devices) < n_shards:
+        raise ValueError(
+            f"pop_shards={n_shards} needs {n_shards} devices, have "
+            f"{len(devices)} (CI uses --xla_force_host_platform_device_count)"
+        )
+    return Mesh(np.asarray(devices[:n_shards]), (POP_AXIS,))
+
+
+def _is_int_leaf(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer) or jnp.issubdtype(
+        jnp.asarray(x).dtype, jnp.bool_
+    )
+
+
+class MeshShardCtx:
+    """Collective pop-shard engine; lives inside a ``shard_map`` body."""
+
+    def __init__(self, n_shards: int, axis: str = POP_AXIS):
+        if n_shards < 2:
+            raise ValueError("MeshShardCtx wants n_shards >= 2; use LOCAL")
+        self.n_shards = n_shards
+        self.axis = axis
+
+    def varying(self, x):
+        """Invarying -> device-varying promotion hook; identity on this
+        jaxlib (grads w.r.t. replicated shard_map inputs are per-device
+        local — no auto-psum — so no pcast is needed or available)."""
+        return x
+
+    def _merge_leaf(self, tag, part):
+        if tag == "sum" and _is_int_leaf(part):
+            return jax.lax.psum(part, self.axis)
+        # float sums / min / max / stack: one shard-ordered all_gather,
+        # then the sequential engine's own fold for bit-equality with it
+        stacked = jax.lax.all_gather(part, self.axis)
+        return shardctx.fold_leaves(stacked, tag, self.n_shards)
+
+    def scan_idx_merge(self, n_chunks: int, body, init, spec):
+        S = self.n_shards
+        if n_chunks % S:
+            raise ValueError(
+                f"n_chunks {n_chunks} not divisible by pop_shards {S}"
+            )
+        cpp = n_chunks // S
+        p = jax.lax.axis_index(self.axis)
+        idxs = p * cpp + jnp.arange(cpp, dtype=jnp.int32)
+
+        def step(carry, c_idx):
+            return body(carry, c_idx), None
+
+        part, _ = jax.lax.scan(step, init, idxs)
+        return shardctx.merge_spec_tree(spec, part, S, self._merge_leaf)
+
+    def scan_merge(self, rebuild, n_chunks: int, body, init, spec):
+        return self.scan_idx_merge(
+            n_chunks, lambda carry, c: body(carry, rebuild(c), c), init, spec
+        )
+
+
+def sharded_packed_vote_counts(
+    mesh: Mesh, words: jnp.ndarray, d: int
+) -> jnp.ndarray:
+    """Packed sign-vote reduce with the [K, W] word rows sharded over the
+    population mesh axis: per-shard bit-plane partial counts, merged by
+    one ``psum``.  Integer counts, so bit-identical to the single-device
+    ``ops.aggregators.packed_sign_votes`` for any row placement — the
+    property the one-bit OTA channel needs to span hosts."""
+    k = words.shape[0]
+    if k % mesh.size:
+        raise ValueError(
+            f"K={k} word rows must divide over the {mesh.size}-way mesh"
+        )
+
+    def body(w_local):
+        return jax.lax.psum(
+            agg_lib._packed_vote_counts_xla(w_local, d), POP_AXIS
+        )
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(P(POP_AXIS),), out_specs=P(),
+        check_rep=False,
+    )
+    return fn(words)
+
+
+class PopShardedFedTrainer(FedTrainer):
+    """FedTrainer whose streamed chunk region runs one ``shard_map``
+    program over a population mesh (``--pop-shards`` devices).
+
+    Everything outside the region — the service draw/churn, the round key
+    splits, the server update, eval — is replicated: the per-round O(K)
+    row masks and O(K*batch) index table cost nothing against the
+    streamed peak, and replicating them keeps the straggler deadline mask
+    identical on every host (the mesh-wide deadline min is satisfied by
+    construction rather than negotiated).  Per-device HBM holds one
+    cohort chunk's rebuild plus the replicated carry — ``obs/hbm.py``
+    models the per-host budget.
+    """
+
+    def __init__(self, cfg, dataset=None, devices: Optional[Sequence] = None):
+        if cfg.pop_shards < 2:
+            raise ValueError(
+                "PopShardedFedTrainer wants pop_shards >= 2 (use FedTrainer "
+                "for the single-scan and sequential engines)"
+            )
+        self.pop_mesh = make_pop_mesh(cfg.pop_shards, devices)
+        super().__init__(cfg, dataset=dataset)
+        # replicated placement for the round inputs the region closes
+        # over / receives: identical buffers on every mesh device, so the
+        # first round's implicit transfers happen once, not per call
+        repl = NamedSharding(self.pop_mesh, P())
+        put = lambda t: jax.tree.map(
+            lambda x: jax.device_put(x, repl) if hasattr(x, "dtype") else x, t
+        )
+        self.x_train = put(self.x_train)
+        self.y_train = put(self.y_train)
+        self.flat_params = put(self.flat_params)
+        self.server_opt_state = put(self.server_opt_state)
+        self.client_m = put(self.client_m)
+        self.fault_state = put(self.fault_state)
+        self.defense_state = put(self.defense_state)
+        self.service_state = put(self.service_state)
+        self.attack_iter = put(self.attack_iter)
+        self._base_key = put(self._base_key)
+
+    def _round_donate_argnums(self):
+        # donating the replicated round carry through the shard_map
+        # program is UNSOUND on this jaxlib's CPU client: the donated
+        # input's per-device buffers are released even though the round
+        # output aliases them, so a live output array's contents rot as
+        # soon as later allocations reuse the memory (observed as
+        # bit-identical loss trajectories with rotten final params, and
+        # as phantom mid-run loss spikes).  TPU/GPU clients keep the full
+        # donation set — the fixed per-host HBM budget depends on it.
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return ()
+        return super()._round_donate_argnums()
+
+    def _make_pop_ctx(self):
+        return MeshShardCtx(self.cfg.pop_shards)
+
+    def _pop_shard_region(self, fn, region_in):
+        ctx = self._pop_ctx
+        wrapped = shard_map(
+            lambda rin: fn(ctx, rin),
+            mesh=self.pop_mesh,
+            in_specs=(P(),),
+            out_specs=P(),
+            check_rep=False,
+        )
+        return wrapped(region_in)

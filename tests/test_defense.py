@@ -266,6 +266,10 @@ def test_config_hash_off_matches_predefense_formula():
         if f.name not in skip + ("defense",) + FedConfig._DEFENSE_KNOBS
         + ("cohort_size",) + FedConfig._COHORT_KNOBS
         + ("service",) + FedConfig._SERVICE_KNOBS
+        # pop_shards follows the same continuity contract with its own
+        # off condition (== 1, not service == "off"), so it is skipped
+        # at this cfg's default exactly like the families above
+        + ("pop_shards",)
     )
     legacy = hashlib.sha256(repr(items).encode()).hexdigest()[:8]
     assert harness.config_hash(cfg) == legacy

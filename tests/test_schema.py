@@ -43,6 +43,12 @@ GOLDEN = {
     2: "a5033a62e61ad318",
     3: "b654d31431900f5b",
     4: "1e58b7097dea230e",
+    # v5 added the host_id ENVELOPE key (stamped by make_event like ts,
+    # so it is not a per-kind required field): the fingerprint — which
+    # digests only _REQUIRED + REFERENCE_KEY_MAP — legitimately matches
+    # v4's, but the version bump is real: consumers merging multi-host
+    # streams key on (host_id, seq) from v5 on
+    5: "1e58b7097dea230e",
 }
 
 
@@ -100,6 +106,16 @@ def test_seq_is_optional_in_validation():
     assert "seq" not in e
     obs_lib.validate_event(e)
     obs_lib.validate_event({**e, "seq": 17})
+
+
+def test_host_id_stamped_on_every_event():
+    # v5: host_id is an envelope key make_event stamps at emission —
+    # jax.process_index() on a multi-process runtime, 0 here — so
+    # multi-host streams can be merged into one total order by
+    # (host_id, seq).  Hand-built v<5 dicts without it must stay
+    # loadable; validation does not require it.
+    e = obs_lib.make_event("span", name="x", ms=1.0)
+    assert e["host_id"] == 0
 
 
 def regen() -> int:

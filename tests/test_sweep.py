@@ -181,6 +181,7 @@ def test_sweep_forwards_every_shared_knob():
         "rollback_cusum": 2.0,
         "rollback_widen": 2.0,
         "rollback_max": 2,
+        "pop_shards": 2,
     }
     # the fault knobs require --fault and full participation
     # (config.validate), so they ride a second, separate sweep cell;
@@ -200,6 +201,10 @@ def test_sweep_forwards_every_shared_knob():
     # the packed sign channel needs a sign-vote consumer and an explicit
     # step size (config.validate), so --sign-bits rides its own signmv cell
     sign_dests = {"sign_bits"}
+    # --pop-shards > 1 needs BOTH --service on and a streamed cohort
+    # (config.validate), which the service and cohort cells each lack —
+    # so it rides its own cell carrying the minimal joint context
+    pop_dests = {"pop_shards"}
     probe = argparse.ArgumentParser()
     add_knob_flags(probe)
     flag_of = {
@@ -218,12 +223,13 @@ def test_sweep_forwards_every_shared_knob():
     orig = sweep_mod.run_sweep
     groups = (
         set(flag_of) - fault_dests - defense_dests - cohort_dests
-        - service_dests - sign_dests,
+        - service_dests - sign_dests - pop_dests,
         fault_dests,
         defense_dests,
         cohort_dests,
         service_dests,
         sign_dests,
+        pop_dests,
     )
     for group in groups:
         argv = list(base)
@@ -232,6 +238,10 @@ def test_sweep_forwards_every_shared_knob():
         if group is sign_dests:
             argv[argv.index("mean")] = "signmv"
             argv += ["--sign-eta", "0.01"]
+        if group is pop_dests:
+            # K=8, cohort 2 -> 4 chunks, divisible by 2 shards
+            argv += ["--service", "on", "--population", "24",
+                     "--cohort-size", "2"]
         for dest in sorted(group):
             argv += [flag_of[dest], str(samples[dest])]
 
