@@ -40,22 +40,16 @@ def load_events(path: str) -> List[dict]:
     (obs_report / audit / this tool) sees a rotated run as one stream.
     """
     from ..obs.sinks import rotated_segments
+    from ..utils.io import iter_jsonl
 
-    events = []
+    def note(msg: str) -> None:
+        print(f"[defense_trace] {msg}", file=sys.stderr)
+
+    events: List[dict] = []
     for p in rotated_segments(path) + [path]:
-        with open(p) as f:
-            for i, line in enumerate(f):
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    events.append(json.loads(line))
-                except json.JSONDecodeError:
-                    print(
-                        f"[defense_trace] skipping malformed line {i + 1} "
-                        f"of {p}",
-                        file=sys.stderr,
-                    )
+        # torn-tail tolerant: a SIGKILLed run tears at most the final
+        # line, and a stream with no run_end is still a valid prefix
+        events.extend(iter_jsonl(p, warn=note))
     return events
 
 

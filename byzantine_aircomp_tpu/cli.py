@@ -599,6 +599,19 @@ def serve_main(argv: Sequence[str]):
                    help="seconds to wait after a submission before "
                         "compiling, so concurrent tenants coalesce into "
                         "one batch (one XLA lowering)")
+    p.add_argument("--queue-cap", type=int, default=0,
+                   help="max queued runs before POST /runs answers 429 "
+                        "(0 = unbounded; docs/RUNBOOK.md)")
+    p.add_argument("--run-retries", type=int, default=1,
+                   help="watchdog requeues per wedged run before it is "
+                        "failed for good")
+    p.add_argument("--run-backoff", type=float, default=2.0,
+                   help="base seconds of the watchdog's exponential "
+                        "requeue backoff (delay = backoff * 2^(retry-1))")
+    p.add_argument("--wedge-secs", type=float, default=0.0,
+                   help="seconds without a completed round before a "
+                        "running run counts as wedged (0 = watchdog off); "
+                        "/healthz reports 503 while any run is wedged")
     args = p.parse_args(list(argv))
     from .serve.server import ExperimentServer
 
@@ -608,6 +621,10 @@ def serve_main(argv: Sequence[str]):
         host=args.host,
         backend=args.backend,
         batch_window=args.batch_window,
+        queue_cap=args.queue_cap,
+        run_retries=args.run_retries,
+        run_backoff=args.run_backoff,
+        wedge_secs=args.wedge_secs,
     ).start()
     print(f"experiment server on {args.host}:{server.port} "
           f"(obs root: {args.obs_root})", flush=True)

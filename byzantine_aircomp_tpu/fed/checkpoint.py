@@ -26,19 +26,28 @@ def checkpoint_file(ckpt_dir: str, title: str) -> str:
 
 
 def save(
-    ckpt_dir: str, title: str, round_idx: int, flat_params, opt_leaves=()
+    ckpt_dir: str,
+    title: str,
+    round_idx: int,
+    flat_params,
+    opt_leaves=(),
+    meta: Optional[str] = None,
 ) -> str:
     """Write params (+ optional extra state leaves, in pytree-leaf order)
     atomically.  ``opt_leaves`` carries everything beyond the params that a
     resume needs — server-optimizer state, fault/defense carries, and under
     ``--service on`` the population availability, widen scale and rollback
-    epoch (see ``harness._extra_state``); this module stays leaf-order
-    agnostic."""
+    epoch (see ``harness.extra_state``); this module stays leaf-order
+    agnostic.  ``meta`` is an opaque string (the experiment server stores
+    the run's metric paths as JSON) that rides the SAME atomic write — a
+    crash can never leave params and paths at different rounds."""
     path = checkpoint_file(ckpt_dir, title)
     # materialize host copies BEFORE acquiring the fd: a device error here
     # must not leak the tmp file
     flat_host = np.asarray(flat_params)
     extras = {f"opt_{i}": np.asarray(leaf) for i, leaf in enumerate(opt_leaves)}
+    if meta is not None:
+        extras["meta_json"] = np.asarray(meta)
     return io_lib.atomic_write(
         path,
         lambda f: np.savez(f, round_idx=round_idx, flat_params=flat_host, **extras),
@@ -56,3 +65,15 @@ def load(
         n_opt = sum(1 for k in z.files if k.startswith("opt_"))
         opt_leaves = [z[f"opt_{i}"] for i in range(n_opt)]
         return int(z["round_idx"]), z["flat_params"], opt_leaves
+
+
+def load_meta(ckpt_dir: str, title: str) -> Optional[str]:
+    """The opaque ``meta`` string saved alongside the checkpoint, or None
+    when the file (or the key — pre-meta checkpoints) is absent."""
+    path = checkpoint_file(ckpt_dir, title)
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        if "meta_json" not in z.files:
+            return None
+        return str(z["meta_json"])

@@ -1043,3 +1043,25 @@ def config_from_mapping(body: dict) -> FedConfig:
     cfg = FedConfig(**kwargs)
     cfg.validate()
     return cfg
+
+
+def config_to_mapping(cfg: FedConfig) -> dict:
+    """The JSON-safe inverse of :func:`config_from_mapping`: every field
+    whose value differs from the dataclass default, as a plain mapping.
+
+    The experiment server's durable journal stores each submission this
+    way (the PRE-namespace config — replay re-namespaces under the same
+    ``run_id``, reproducing the original paths), so the round trip
+    ``config_from_mapping(config_to_mapping(cfg)) == cfg`` must hold for
+    any valid config; tests/test_chaos.py pins it."""
+    out = {}
+    for f in dataclasses.fields(FedConfig):
+        default = (
+            f.default
+            if f.default is not dataclasses.MISSING
+            else f.default_factory()  # type: ignore[misc]
+        )
+        value = getattr(cfg, f.name)
+        if value != default:
+            out[f.name] = value
+    return out

@@ -369,14 +369,189 @@ def _make_trainer(cfg: FedConfig, trainer_cls):
     return trainer_cls(cfg)
 
 
-def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
+def extra_state(t, cfg: FedConfig):
+    """Everything beyond flat params that must survive a resume, as one
+    pytree: server-optimizer state, the client-momentum buffer, the
+    fault-injection carry (stale-update buffer + Gilbert-Elliott channel
+    state), the defense carry (detector baselines + policy rung/streaks),
+    the attack-onset iteration counter, and the service carry (population
+    availability + widened trim scale) with the rollback epoch.  The leaf
+    ORDER is the checkpoint contract — the experiment server's per-lane
+    checkpoints (``serve/batch.BatchRunner.lane_state``) emit the same
+    layout so batch-lane and solo checkpoints are interchangeable."""
+    return (
+        getattr(t, "server_opt_state", ()),
+        getattr(t, "client_m", ()),
+        getattr(t, "fault_state", ()),
+        getattr(t, "defense_state", ()),
+        getattr(t, "attack_iter", ()),
+        getattr(t, "service_state", ()),
+        (
+            jnp.int32(getattr(t, "_rollback_epoch", 0))
+            if cfg.service == "on" else ()
+        ),
+    )
+
+
+def restore_trainer(trainer, cfg: FedConfig, restored, log_fn=None) -> int:
+    """Install a ``checkpoint.load`` result into a freshly built trainer;
+    returns the round to resume from.  Restores through the trainer's own
+    leaf shardings (a plain asarray would drop the mesh placement on
+    sharded runs) and tolerates a leaf-count mismatch by keeping the
+    extra state fresh — params alone still resume the trajectory of the
+    reference layout."""
+    import jax
+
+    log_fn = log_fn or log
+    start_round, flat, extra_leaves = restored
+    trainer.flat_params = jax.device_put(flat, trainer.flat_params.sharding)
+    own_state = extra_state(trainer, cfg)
+    own_leaves = jax.tree.leaves(own_state)
+    if len(extra_leaves) == len(own_leaves) and extra_leaves:
+        (
+            server_state, client_m, fault_state, defense_state,
+            attack_iter, service_state, rollback_epoch,
+        ) = jax.tree.unflatten(
+            jax.tree.structure(own_state),
+            [
+                jax.device_put(l, own.sharding)
+                for l, own in zip(extra_leaves, own_leaves)
+            ],
+        )
+        trainer.server_opt_state = server_state
+        if not isinstance(client_m, tuple):  # () when disabled
+            trainer.client_m = client_m
+        if jax.tree.leaves(fault_state):  # ()-only when disabled
+            trainer.fault_state = fault_state
+        if jax.tree.leaves(defense_state):
+            trainer.defense_state = defense_state
+        if not isinstance(attack_iter, tuple):  # scalar when on
+            trainer.attack_iter = attack_iter
+        if jax.tree.leaves(service_state):
+            trainer.service_state = service_state
+        if not isinstance(rollback_epoch, tuple):
+            # epoch == rollbacks-so-far by construction (the trainer
+            # bumps them together), so one saved scalar restores both
+            # the key salt and the budget
+            trainer._rollback_epoch = int(rollback_epoch)
+            trainer._rollbacks_done = int(rollback_epoch)
+    elif len(extra_leaves) != len(own_leaves):
+        log_fn(
+            "WARNING: checkpoint extra state "
+            f"({len(extra_leaves)} leaves) does not match this "
+            f"config ({len(own_leaves)}); starting server-opt/"
+            "client-momentum state fresh"
+        )
+    return start_round
+
+
+#: paths whose index 0 is the pre-training eval — on a resume the restored
+#: run re-evaluates the checkpointed params as ITS index 0, a bit-exact
+#: duplicate of the prefix's last entry, so the merge drops it
+_EVAL_PATH_KEYS = ("trainLossPath", "trainAccPath", "valLossPath", "valAccPath")
+
+
+def merge_paths(prefix: Dict[str, list], current: Dict[str, list]) -> Dict[str, list]:
+    """Concatenate a checkpointed paths prefix with a resumed run's paths
+    so the merged record is indistinguishable from an uninterrupted run
+    (floats round-trip bit-exactly through the JSON the checkpoint meta
+    stores; only the timing-derived ``roundsPerSec`` entries differ)."""
+    merged: Dict[str, list] = {}
+    for key, cur in current.items():
+        pre = prefix.get(key) or []
+        if pre and key in _EVAL_PATH_KEYS:
+            merged[key] = list(pre) + list(cur[1:])
+        else:
+            merged[key] = list(pre) + list(cur)
+    return merged
+
+
+def build_record(
+    cfg: FedConfig,
+    paths: Dict[str, list],
+    *,
+    dataset_name: str,
+    dataset_size: int,
+    max_feature: int,
+) -> Dict:
+    """The reference-format pickled record from a finished run's paths.
+    One constructor for every execution path — the solo harness and the
+    experiment server's batch lanes build records through this, so the
+    server-path record is bit-identical to a solo run of the same config."""
+    record = {
+        # dataset config block (reference dataSetConfig, :536-541)
+        "name": dataset_name,
+        "dataSet": dataset_name,
+        "dataSetSize": dataset_size,
+        "maxFeature": max_feature,
+        # config block with callables already as names (reference :474-479)
+        "honestSize": cfg.honest_size,
+        "byzantineSize": cfg.byz_size,
+        "rounds": cfg.rounds,
+        "displayInterval": cfg.display_interval,
+        "weight_decay": cfg.weight_decay,
+        "fixSeed": cfg.fix_seed,
+        "SEED": cfg.seed,
+        "batchSize": cfg.batch_size,
+        "gamma": cfg.gamma,
+        "aggregate": cfg.agg,
+        "attack": cfg.attack,
+        "noise_var": cfg.noise_var,
+        "model": cfg.model,
+        # metric paths (reference :481-489)
+        "trainLossPath": paths["trainLossPath"],
+        "trainAccPath": paths["trainAccPath"],
+        "valLossPath": paths["valLossPath"],
+        "valAccPath": paths["valAccPath"],
+        "variencePath": paths["variencePath"],
+        # framework extras
+        "roundsPerSec": paths["roundsPerSec"],
+    }
+    if cfg.fault is not None:
+        record["fault"] = cfg.fault
+        record["faultOverrides"] = cfg.fault_overrides()
+        record["faultDroppedPath"] = paths["faultDroppedPath"]
+        record["faultErasedPath"] = paths["faultErasedPath"]
+        record["faultCorruptPath"] = paths["faultCorruptPath"]
+        record["effectiveKPath"] = paths["effectiveKPath"]
+    if cfg.defense != "off":
+        from ..defense import events as defense_events
+
+        record["defense"] = cfg.defense
+        record["defenseLadder"] = list(cfg.defense_ladder_names())
+        for path_key in defense_events.PATH_KEYS.values():
+            record[path_key] = paths[path_key]
+    if cfg.service == "on":
+        record["service"] = cfg.service
+        record["population"] = cfg.population
+        record["serviceAvailPath"] = paths["serviceAvailPath"]
+        record["serviceAbsentPath"] = paths["serviceAbsentPath"]
+        record["serviceLatePath"] = paths["serviceLatePath"]
+        record["effectiveKPath"] = paths["effectiveKPath"]
+    return record
+
+
+def run(
+    cfg: FedConfig,
+    record_in_file: bool = True,
+    persist_paths: bool = False,
+    on_checkpoint=None,
+) -> Dict:
     """Build a trainer, run the full schedule, pickle the record.
 
     Mirrors reference ``run`` (``:427-492``): when no attack is given the
     Byzantine count is zeroed (``:430-431``).  With ``--obs-dir`` /
     ``--obs-stdout`` set, a schema-versioned event stream (run_start /
     span / round / retrace / run_end) is emitted ALONGSIDE — never
-    instead of — the reference-compatible pickled record."""
+    instead of — the reference-compatible pickled record.
+
+    ``persist_paths`` (the experiment server's solo-lane mode) stores the
+    metrics recorded so far inside every checkpoint's atomic write and,
+    on an ``--inherit`` resume, merges that prefix back so the final
+    record covers the WHOLE schedule — bit-identical to an uninterrupted
+    run — instead of only the resumed suffix.  ``on_checkpoint(round)``
+    fires after each durable checkpoint (the server journals progress
+    through it)."""
     if cfg.attack is None:
         cfg.byz_size = 0
     cfg.validate()
@@ -400,14 +575,23 @@ def run(cfg: FedConfig, record_in_file: bool = True) -> Dict:
             f"Serving /metrics and /healthz on port {obs.exporter.port}"
         )
     try:
-        return _run_inner(cfg, record_in_file, obs)
+        return _run_inner(
+            cfg, record_in_file, obs,
+            persist_paths=persist_paths, on_checkpoint=on_checkpoint,
+        )
     finally:
         obs.close()
         restore_stderr()
         restore_log()
 
 
-def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
+def _run_inner(
+    cfg: FedConfig,
+    record_in_file: bool,
+    obs,
+    persist_paths: bool = False,
+    on_checkpoint=None,
+) -> Dict:
     from ..obs import hbm as hbm_lib
     from ..obs import profile as profile_lib
     from ..registry import OPTIMIZERS
@@ -423,6 +607,7 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
     # checkpoint / resume (the reference's --inherit was dead; :22,:500)
     start_round = 0
     checkpoint_fn = None
+    resume_prefix = None
     # keyed on ckpt_title (run_title + config hash): run_title alone omits
     # seed/sizes/dataset/gamma/widths, so distinct cells could silently
     # resume each other's state from a shared checkpoint dir
@@ -430,81 +615,34 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
     if cfg.checkpoint_dir:
         import jax
 
-        # everything beyond flat params that must survive a resume:
-        # server-optimizer state, the client-momentum buffer, the
-        # fault-injection carry (stale-update buffer + Gilbert-Elliott
-        # channel state), the defense carry (detector baselines + policy
-        # rung/streaks), the attack-onset iteration counter, and the
-        # service carry (population availability + widened trim scale)
-        # with the rollback epoch, as one pytree so the leaf-count match
-        # covers all
-        def _extra_state(t):
-            return (
-                getattr(t, "server_opt_state", ()),
-                getattr(t, "client_m", ()),
-                getattr(t, "fault_state", ()),
-                getattr(t, "defense_state", ()),
-                getattr(t, "attack_iter", ()),
-                getattr(t, "service_state", ()),
-                (
-                    jnp.int32(getattr(t, "_rollback_epoch", 0))
-                    if cfg.service == "on" else ()
-                ),
-            )
+        def checkpoint_fn(r, t):
+            meta = None
+            if persist_paths and getattr(t, "_last_paths", None) is not None:
+                import json as _json
 
-        checkpoint_fn = lambda r, t: checkpoint.save(
-            cfg.checkpoint_dir,
-            title,
-            r,
-            t.flat_params,
-            jax.tree.leaves(_extra_state(t)),
-        )
+                meta = _json.dumps(t._last_paths)
+            checkpoint.save(
+                cfg.checkpoint_dir,
+                title,
+                r,
+                t.flat_params,
+                jax.tree.leaves(extra_state(t, cfg)),
+                meta=meta,
+            )
+            if on_checkpoint is not None:
+                on_checkpoint(r)
+
         if cfg.inherit:
             restored = checkpoint.load(cfg.checkpoint_dir, title)
             if restored is not None:
-                start_round, flat, extra_leaves = restored
-                # restore through the trainer's existing layouts — a plain
-                # asarray would drop the mesh sharding on sharded runs
-                trainer.flat_params = jax.device_put(
-                    flat, trainer.flat_params.sharding
-                )
-                own_state = _extra_state(trainer)
-                own_leaves = jax.tree.leaves(own_state)
-                if len(extra_leaves) == len(own_leaves) and extra_leaves:
-                    (
-                        server_state, client_m, fault_state, defense_state,
-                        attack_iter, service_state, rollback_epoch,
-                    ) = jax.tree.unflatten(
-                        jax.tree.structure(own_state),
-                        [
-                            jax.device_put(l, own.sharding)
-                            for l, own in zip(extra_leaves, own_leaves)
-                        ],
-                    )
-                    trainer.server_opt_state = server_state
-                    if not isinstance(client_m, tuple):  # () when disabled
-                        trainer.client_m = client_m
-                    if jax.tree.leaves(fault_state):  # ()-only when disabled
-                        trainer.fault_state = fault_state
-                    if jax.tree.leaves(defense_state):
-                        trainer.defense_state = defense_state
-                    if not isinstance(attack_iter, tuple):  # scalar when on
-                        trainer.attack_iter = attack_iter
-                    if jax.tree.leaves(service_state):
-                        trainer.service_state = service_state
-                    if not isinstance(rollback_epoch, tuple):
-                        # epoch == rollbacks-so-far by construction (the
-                        # trainer bumps them together), so one saved scalar
-                        # restores both the key salt and the budget
-                        trainer._rollback_epoch = int(rollback_epoch)
-                        trainer._rollbacks_done = int(rollback_epoch)
-                elif len(extra_leaves) != len(own_leaves):
-                    log(
-                        "WARNING: checkpoint extra state "
-                        f"({len(extra_leaves)} leaves) does not match this "
-                        f"config ({len(own_leaves)}); starting server-opt/"
-                        "client-momentum state fresh"
-                    )
+                if persist_paths:
+                    # grab the paths prefix BEFORE the resumed run's own
+                    # checkpoints overwrite the file
+                    import json as _json
+
+                    meta = checkpoint.load_meta(cfg.checkpoint_dir, title)
+                    resume_prefix = None if meta is None else _json.loads(meta)
+                start_round = restore_trainer(trainer, cfg, restored)
                 log(f"Resumed from checkpoint at round {start_round}")
 
     import jax
@@ -569,6 +707,11 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
         )
     finally:
         profiler.close()
+    if resume_prefix and start_round > 0:
+        # the resumed run's index-0 eval re-evaluates the restored params —
+        # bit-identical to the prefix's last entry — so the merged paths
+        # read as one uninterrupted schedule
+        paths = merge_paths(resume_prefix, paths)
     if profiler.captured:
         obs.emit(
             "profile",
@@ -722,58 +865,13 @@ def _run_inner(cfg: FedConfig, record_in_file: bool, obs) -> Dict:
             alerts=alert_summary,
         )
 
-    record = {
-        # dataset config block (reference dataSetConfig, :536-541)
-        "name": trainer.dataset.name,
-        "dataSet": trainer.dataset.name,
-        "dataSetSize": len(trainer.dataset.x_train),
-        "maxFeature": int(
-            trainer.dataset.x_train[0].size
-        ),
-        # config block with callables already as names (reference :474-479)
-        "honestSize": cfg.honest_size,
-        "byzantineSize": cfg.byz_size,
-        "rounds": cfg.rounds,
-        "displayInterval": cfg.display_interval,
-        "weight_decay": cfg.weight_decay,
-        "fixSeed": cfg.fix_seed,
-        "SEED": cfg.seed,
-        "batchSize": cfg.batch_size,
-        "gamma": cfg.gamma,
-        "aggregate": cfg.agg,
-        "attack": cfg.attack,
-        "noise_var": cfg.noise_var,
-        "model": cfg.model,
-        # metric paths (reference :481-489)
-        "trainLossPath": paths["trainLossPath"],
-        "trainAccPath": paths["trainAccPath"],
-        "valLossPath": paths["valLossPath"],
-        "valAccPath": paths["valAccPath"],
-        "variencePath": paths["variencePath"],
-        # framework extras
-        "roundsPerSec": paths["roundsPerSec"],
-    }
-    if cfg.fault is not None:
-        record["fault"] = cfg.fault
-        record["faultOverrides"] = cfg.fault_overrides()
-        record["faultDroppedPath"] = paths["faultDroppedPath"]
-        record["faultErasedPath"] = paths["faultErasedPath"]
-        record["faultCorruptPath"] = paths["faultCorruptPath"]
-        record["effectiveKPath"] = paths["effectiveKPath"]
-    if cfg.defense != "off":
-        from ..defense import events as defense_events
-
-        record["defense"] = cfg.defense
-        record["defenseLadder"] = list(cfg.defense_ladder_names())
-        for path_key in defense_events.PATH_KEYS.values():
-            record[path_key] = paths[path_key]
-    if cfg.service == "on":
-        record["service"] = cfg.service
-        record["population"] = cfg.population
-        record["serviceAvailPath"] = paths["serviceAvailPath"]
-        record["serviceAbsentPath"] = paths["serviceAbsentPath"]
-        record["serviceLatePath"] = paths["serviceLatePath"]
-        record["effectiveKPath"] = paths["effectiveKPath"]
+    record = build_record(
+        cfg,
+        paths,
+        dataset_name=trainer.dataset.name,
+        dataset_size=len(trainer.dataset.x_train),
+        max_feature=int(trainer.dataset.x_train[0].size),
+    )
     if record_in_file:
         io_lib.atomic_pickle(path, record)
     return record

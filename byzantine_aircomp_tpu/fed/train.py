@@ -2019,6 +2019,11 @@ class FedTrainer:
             paths["serviceAbsentPath"] = []
             paths["serviceLatePath"] = []
             paths["effectiveKPath"] = []
+        # live reference for checkpoint hooks: paths is appended in place,
+        # so a checkpoint_fn can persist the metrics recorded so far (the
+        # experiment server's crash-resume rides this — harness.run with
+        # persist_paths saves them inside the checkpoint's atomic write)
+        self._last_paths = paths
         log(
             f"[0/{cfg.rounds}](interval: {cfg.display_interval}) "
             f"train: loss={tr_loss:.4f} acc={tr_acc:.4f} "

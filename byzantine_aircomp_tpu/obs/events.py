@@ -62,7 +62,14 @@ from typing import Any, Dict, Optional
 # processes each append their own stream can be merged into one total
 # order by ``(host_id, seq)`` — ``seq`` alone is only per-sink monotonic,
 # and two hosts' sinks both start at 0.
-SCHEMA_VERSION = 5
+# v6: added the crash-safety serving kinds ``run_failed`` (a lane
+# quarantined by the BatchRunner health guards, or a run whose retries
+# are exhausted — exactly one per failed run), ``run_requeued`` (the
+# watchdog cancelled a wedged run and scheduled a bounded-backoff
+# retry), and ``journal_replay`` (a restarted server re-adopted this run
+# from the durable journal — ``status`` says resumed/restarted and
+# ``round`` the checkpoint it resumes from).
+SCHEMA_VERSION = 6
 
 # round-event field -> reference pickled-record key it mirrors
 # (round r's event carries metrics the record stores at index r+1 for the
@@ -129,6 +136,14 @@ _REQUIRED: Dict[str, tuple] = {
     "run_submitted": ("run_id", "title", "signature"),
     "run_cancelled": ("run_id", "round"),
     "knob_swap": ("run_id", "round", "knob", "value"),
+    # crash-safe serving (serve/runs.py, serve/journal.py): quarantine /
+    # watchdog terminal failure (exactly one per failed run, with the
+    # machine-readable reason), the watchdog's bounded-backoff requeue
+    # notice, and the journal-replay adoption marker a restarted server
+    # writes into each re-adopted run's stream
+    "run_failed": ("run_id", "round", "reason"),
+    "run_requeued": ("run_id", "round", "retries", "reason"),
+    "journal_replay": ("run_id", "status", "round"),
 }
 
 

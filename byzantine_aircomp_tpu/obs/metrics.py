@@ -425,8 +425,24 @@ class MetricsSink(EventSink):
             )
 
     # health -------------------------------------------------------------
+
+    #: seconds without a completed round before a "running" run reports
+    #: itself wedged (/healthz flips to 503 through the exporter's
+    #: ok-keyed status).  0 disables the check — wedge detection is
+    #: opt-in (`--wedge-secs` arms the serve-side watchdog, which sets
+    #: this on the sinks it owns); a standalone sink never flips its
+    #: health on wall-clock alone.  The age only exists once a first
+    #: round has completed, so a long initial compile never trips it.
+    wedge_secs: float = 0.0
+
     def health(self, now: Optional[float] = None) -> Dict[str, Any]:
-        """The /healthz body: run phase, last-round age, rollback epoch."""
+        """The /healthz body: run phase, last-round age, rollback epoch.
+
+        ``ok`` goes False — and the exporter's ``/healthz`` returns 503,
+        so k8s-style probes work without parsing the body — when the run
+        claims to be running but no round has completed for longer than
+        :attr:`wedge_secs`; a ``reason`` key is added only then (the
+        healthy body shape is unchanged)."""
         import time as _time
 
         reg = self.registry
@@ -440,11 +456,23 @@ class MetricsSink(EventSink):
             age = round((now if now is not None else _time.time()) - last_ts, 3)
         last_round = reg.value("aircomp_round")
         epoch = reg.value("aircomp_rollback_epoch")
-        return {
-            "ok": True,
+        wedged = (
+            phase == "running"
+            and self.wedge_secs > 0
+            and age is not None
+            and age > self.wedge_secs
+        )
+        body = {
+            "ok": not wedged,
             "phase": phase,
             "last_round": None if last_round is None else int(last_round),
             "last_round_age_secs": age,
             "rollback_epoch": 0 if epoch is None else int(epoch),
             "alerts_firing": int(reg.value("aircomp_alerts_firing") or 0),
         }
+        if wedged:
+            body["reason"] = (
+                f"wedged: no completed round in {age:.0f}s "
+                f"(threshold {self.wedge_secs:g}s)"
+            )
+        return body

@@ -265,6 +265,7 @@ class BatchRunner:
         dataset=None,
         retrace: Optional[obs_lib.RetraceDetector] = None,
         backend: str = "vmap",
+        restore_fn: Optional[Callable[[int, Any], None]] = None,
     ) -> None:
         from ..data import datasets as data_lib
         from ..fed.train import FedTrainer
@@ -276,6 +277,12 @@ class BatchRunner:
         self.n = len(self.cfgs)
         dataset = dataset or data_lib.load(self.cfgs[0].dataset)
         self.trainers = [FedTrainer(c, dataset=dataset) for c in self.cfgs]
+        if restore_fn is not None:
+            # checkpoint resume hook: install restored state into each
+            # lane's trainer BEFORE the carries are stacked (the server's
+            # crash-recovery path — see harness.restore_trainer)
+            for lane, t in enumerate(self.trainers):
+                restore_fn(lane, t)
         self.template = self.trainers[0]
         self.knobs = gather_knobs(self.cfgs)
         self.carry = jax.tree.map(
@@ -285,6 +292,10 @@ class BatchRunner:
         self.base_keys = jnp.stack([t._base_key for t in self.trainers])
         self.retrace = retrace or obs_lib.RetraceDetector()
         self.active = [True] * self.n
+        #: lane -> quarantine reason; a poisoned lane (non-finite params/
+        #: variance/loss, exception in its eval) is evicted from recording
+        #: while the surviving lanes continue in the same lowering
+        self.failed: Dict[int, str] = {}
         build = self._build_vmap if backend == "vmap" else self._build_map
         self._batched_fn = jax.jit(
             self.retrace.wrap("batch_round_fn", build()),
@@ -347,6 +358,53 @@ class BatchRunner:
 
     def lane_params(self, lane: int):
         return self.carry[0][lane]
+
+    def lane_state(self, lane: int):
+        """One lane's resumable state as host arrays in
+        ``harness.extra_state`` leaf order — ``(flat_params,
+        extra_leaves)`` ready for ``checkpoint.save``, so a batch-lane
+        checkpoint restores through the same path as a solo one.  The
+        carry slots after params (server-opt, client momentum, fault,
+        defense, attack-iter, service) match the solo tuple's first six
+        slots; the rollback-epoch tail is pinned 0 because service
+        batches require rollback off (validate_batch)."""
+        flat = np.asarray(self.carry[0][lane])
+        extras = [
+            np.asarray(leaf[lane])
+            for leaf in jax.tree.leaves(tuple(self.carry[1:]))
+        ]
+        if self.cfgs[lane].service == "on":
+            extras.append(np.zeros((), np.int32))
+        return flat, extras
+
+    def _quarantine(
+        self, lane: int, round_idx: int, reason: str, on_quarantine, log
+    ) -> None:
+        """Evict a poisoned lane: stop recording, freeze its carry row
+        finite (an eager per-row ``.at[lane].set`` — same shapes/dtypes,
+        so the jitted program never retraces), and notify the control
+        plane.  Cotenant lanes are untouched: under vmap every lane's
+        computation is independent, so the survivors stay bit-identical
+        to a batch that never contained the poisoned tenant."""
+        self.active[lane] = False
+        self.failed[lane] = reason
+
+        def freeze(leaf):
+            if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                return leaf.at[lane].set(
+                    jnp.nan_to_num(leaf[lane], posinf=0.0, neginf=0.0)
+                )
+            return leaf
+
+        self.carry = jax.tree.map(freeze, self.carry)
+        log(f"[lane {lane}] QUARANTINED at round {round_idx}: {reason}")
+        if on_quarantine is not None:
+            try:
+                on_quarantine(lane, round_idx, reason)
+            except Exception:  # a control-plane bug must not kill cotenants
+                import traceback
+
+                traceback.print_exc()
 
     def evaluate(self, lane: int, split: str = "val"):
         """Per-lane eval through the TEMPLATE's jitted eval fn (one
@@ -430,17 +488,43 @@ class BatchRunner:
         obs_list: Optional[Sequence["obs_lib.Observability"]] = None,
         start_round: int = 0,
         before_round: Optional[Callable[[int], None]] = None,
+        after_round: Optional[Callable[[int], None]] = None,
+        resume_paths: Optional[Sequence[Optional[Dict[str, list]]]] = None,
+        on_quarantine: Optional[Callable[[int, int, str], None]] = None,
     ) -> List[Dict[str, list]]:
         """Drive every lane to ``cfg.rounds``; returns per-lane paths
         dicts mirroring ``FedTrainer.train`` (same keys, same float
         conversions — the bit-identity surface).  ``obs_list`` supplies
         one Observability per lane (None entries allowed);
         ``before_round(r)`` runs at each round boundary — the control
-        plane applies queued knob swaps and cancellations there."""
+        plane applies queued knob swaps and cancellations there —
+        and ``after_round(r)`` after the round's lanes are recorded (the
+        control plane checkpoints there, reading ``self.paths_list``).
+
+        Resume: ``start_round=r`` with ``resume_paths[i]`` holding lane
+        i's checkpointed paths (entries through round r) continues a
+        crashed batch — the per-round ``fold_in`` keys make the suffix
+        bit-identical to the uninterrupted run.  Lanes with a None entry
+        start fresh (initial eval at index 0).
+
+        Quarantine: a lane whose params/variance go non-finite, whose
+        eval returns a non-finite loss, or whose recording raises is
+        evicted (``self.failed[lane]`` holds the reason,
+        ``on_quarantine(lane, round, reason)`` notifies the control
+        plane) while the surviving lanes continue — same lowering, no
+        retrace."""
         log = log_fn or (lambda s: None)
         obs_list = list(obs_list) if obs_list else [None] * self.n
         cfg0 = self.cfgs[0]
-        paths_list = [self._init_paths(i) for i in range(self.n)]
+        paths_list = [
+            (
+                dict(resume_paths[i])
+                if resume_paths is not None and resume_paths[i] is not None
+                else self._init_paths(i)
+            )
+            for i in range(self.n)
+        ]
+        self.paths_list = paths_list
         prev_rung = [
             int(t.defense_state[1][0]) if t.defense is not None else None
             for t in self.trainers
@@ -457,6 +541,14 @@ class BatchRunner:
             compiled = self.retrace.count("batch_round_fn") > before
             dt = time.perf_counter() - t0
             var_np = np.asarray(variance)
+            # per-lane health: a poisoned tenant (divergent gamma, hostile
+            # knob swap) shows up as non-finite params or dispersion; one
+            # [N]-reduction per round keeps the check off the hot path
+            finite_np = np.asarray(
+                jnp.isfinite(self.carry[0]).all(
+                    axis=tuple(range(1, self.carry[0].ndim))
+                )
+            )
             fm_np = (
                 np.asarray(self.last_fault_metrics)
                 if self.template.fault is not None else None
@@ -472,14 +564,39 @@ class BatchRunner:
             for i in range(self.n):
                 if not self.active[i]:
                     continue
-                self._record_lane(
-                    i, r, float(var_np[i]),
-                    None if fm_np is None else fm_np[i],
-                    None if dm_np is None else dm_np[i],
-                    None if sm_np is None else sm_np[i],
-                    dt, compiled, paths_list[i], obs_list[i], prev_rung,
-                    log,
-                )
+                if not np.isfinite(var_np[i]):
+                    self._quarantine(
+                        i, r, "non-finite round variance", on_quarantine, log
+                    )
+                    continue
+                if not finite_np[i]:
+                    self._quarantine(
+                        i, r, "non-finite parameters", on_quarantine, log
+                    )
+                    continue
+                try:
+                    self._record_lane(
+                        i, r, float(var_np[i]),
+                        None if fm_np is None else fm_np[i],
+                        None if dm_np is None else dm_np[i],
+                        None if sm_np is None else sm_np[i],
+                        dt, compiled, paths_list[i], obs_list[i], prev_rung,
+                        log,
+                    )
+                except Exception as exc:  # one lane's eval must not kill N-1
+                    self._quarantine(
+                        i, r,
+                        f"recording error: {type(exc).__name__}: {exc}",
+                        on_quarantine, log,
+                    )
+                    continue
+                va = paths_list[i]["valLossPath"][-1]
+                if not np.isfinite(va):
+                    self._quarantine(
+                        i, r, "non-finite validation loss", on_quarantine, log
+                    )
+            if after_round is not None:
+                after_round(r)
         return paths_list
 
     def _record_lane(

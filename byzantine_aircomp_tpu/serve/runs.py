@@ -4,49 +4,76 @@ A :class:`RunManager` owns the lifecycle of every submitted run:
 
 * ``submit`` assigns a ``run_id``, rebases the config's output paths onto
   the run's private subtree (``harness.run_namespace`` — the tenancy
-  boundary), opens the run's own event stream, and queues it under its
-  :func:`~.batch.static_signature`.
+  boundary), journals the submission (``serve/journal.py`` — the durable
+  write-ahead log a restarted server replays), opens the run's own event
+  stream, and queues it under its :func:`~.batch.static_signature`.
 * The scheduler (a background thread started by :meth:`start`, or a
-  direct :meth:`drain` call from tests) groups queued runs by signature
-  and executes each group through ONE shared :class:`~.batch.BatchRunner`
-  — that grouping is what turns 64 tenant submissions into a single XLA
-  lowering.
+  direct :meth:`drain` call from tests) groups queued runs by
+  ``(signature, resume_round)`` and executes each group through ONE
+  shared :class:`~.batch.BatchRunner` — that grouping is what turns 64
+  tenant submissions into a single XLA lowering.  Streamed/mesh configs
+  (``cohort_size > 0`` or ``pop_shards > 1``), which the batch contract
+  rejects, run as SOLO single-lane groups through the ordinary
+  ``harness.run`` path instead of being refused.
 * Between rounds (the BatchRunner's ``before_round`` hook) queued knob
-  swaps and cancellations land: a swap is a per-lane device-array update
-  (``set_knob`` — never a retrace, and the post-group lowering count is
-  recorded on every run so the guarantee is auditable per tenant), a
-  cancel flips the lane dark (compute still rides the batch; recording
-  stops).
+  swaps and cancellations land; after each round (``after_round``) every
+  live lane writes a durable checkpoint — params + opt carries + the
+  metric paths recorded so far, one atomic npz — so a killed server
+  resumes every in-flight run from its last round boundary with final
+  records bit-identical to an uninterrupted run.
+* A poisoned lane (non-finite params/variance/loss, exception in eval)
+  is quarantined by the BatchRunner health guards: the run fails with
+  exactly one ``run_failed`` event naming the reason while its cotenants
+  continue in the same lowering.
+* A watchdog thread (``wedge_secs > 0``) detects runs that stop making
+  progress, cancels and requeues them with bounded retries and
+  exponential backoff (``run_retries`` / ``run_backoff``), and reports
+  the service degraded (the server's ``/healthz`` flips to 503) while
+  any run is wedged.
 
 Every tenant-visible state change is an audit event in the run's own
-stream — ``run_submitted`` / ``knob_swap`` / ``run_cancelled`` (schema
-v4) — and, when the manager was given a shared registry, every run's
-metrics land under its own ``run_id`` label via
+stream — ``run_submitted`` / ``knob_swap`` / ``run_cancelled`` /
+``run_failed`` / ``run_requeued`` / ``journal_replay`` (schema v6) —
+and, when the manager was given a shared registry, every run's metrics
+land under its own ``run_id`` label via
 :class:`~..obs.metrics.LabeledRegistry`, so one ``/metrics`` scrape shows
-all tenants side by side.
+all tenants side by side.  docs/RUNBOOK.md is the operator guide.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import json
+import sys
 import threading
 import time
 import traceback
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .. import obs as obs_lib
-from ..fed import harness
-from ..fed.config import FedConfig
+from ..fed import checkpoint, harness
+from ..fed.config import FedConfig, config_from_mapping, config_to_mapping
+from ..utils import io as io_lib
+from . import journal as journal_lib
 from .batch import BatchRunner, applicable_knobs, static_signature
 
 #: terminal statuses — no further transitions, obs stream closed
 _DONE = ("completed", "cancelled", "failed")
 
 
+class QueueFull(RuntimeError):
+    """Submission rejected by the queue cap (HTTP maps this to 429)."""
+
+
+def _warn(msg: str) -> None:
+    print(f"[serve] {msg}", file=sys.stderr)
+
+
 class Run:
     """One tenant run: config + lifecycle + its private output subtree.
 
     Not self-locking — the manager's lock guards every mutation (the
-    scheduler thread and HTTP handler threads both touch runs).
+    scheduler, watchdog, and HTTP handler threads all touch runs).
     """
 
     def __init__(self, run_id: str, cfg: FedConfig, signature: str) -> None:
@@ -64,6 +91,16 @@ class Run:
         self.cancel_requested = False
         self.paths: Optional[Dict[str, list]] = None
         self.obs: obs_lib.Observability = obs_lib.NULL
+        # crash-safety / supervision state
+        self.solo = False  # streamed/mesh config: single-lane harness path
+        self.resume_round = 0  # checkpointed round a (re)start resumes from
+        self.retries = 0  # watchdog requeues consumed
+        self.wedged = False  # watchdog flagged: no progress in wedge_secs
+        self.attempt = 0  # execution epoch: stale group closures no-op
+        self.last_progress = time.time()
+        self.idempotency_key: Optional[str] = None
+        self.final: Optional[Dict[str, Any]] = None  # journal-adopted val stats
+        self.record_path: Optional[str] = None
 
     def info(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -82,13 +119,28 @@ class Run:
             },
             "swaps": list(self.applied_swaps),
         }
+        if self.solo:
+            d["solo"] = True
+        if self.resume_round:
+            d["resume_round"] = self.resume_round
+        if self.retries:
+            d["retries"] = self.retries
+        if self.wedged:
+            d["wedged"] = True
         if self.lowerings is not None:
             d["lowerings"] = self.lowerings
         if self.error is not None:
             d["error"] = self.error
+        if self.record_path is not None:
+            d["record"] = self.record_path
         if self.paths and self.paths.get("valLossPath"):
             d["val_loss"] = self.paths["valLossPath"][-1]
             d["val_acc"] = self.paths["valAccPath"][-1]
+        elif self.final is not None:
+            if self.final.get("val_loss") is not None:
+                d["val_loss"] = self.final["val_loss"]
+            if self.final.get("val_acc") is not None:
+                d["val_acc"] = self.final["val_acc"]
         return d
 
 
@@ -102,44 +154,102 @@ class RunManager:
         dataset=None,
         backend: str = "vmap",
         batch_window: float = 0.25,
+        queue_cap: int = 0,
+        run_retries: int = 1,
+        run_backoff: float = 2.0,
+        wedge_secs: float = 0.0,
     ) -> None:
         self.obs_root = obs_root
         self.registry = registry
         self._dataset = dataset
         self._backend = backend
         self._batch_window = batch_window
+        self.queue_cap = queue_cap
+        self.run_retries = run_retries
+        self.run_backoff = run_backoff
+        self.wedge_secs = wedge_secs
+        self.journal = journal_lib.RunJournal(journal_lib.journal_path(obs_root))
         self._lock = threading.RLock()
         self._runs: Dict[str, Run] = {}
         self._order: List[str] = []
         self._pending: List[str] = []
+        self._idem: Dict[str, str] = {}
+        self._requeue_at: Dict[str, float] = {}
         self._seq = 0
         self._wake = threading.Event()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        self._watchdog: Optional[threading.Thread] = None
         self._dataset_cache: Dict[str, Any] = {}
 
     # ---------------------------------------------------------- registry
 
-    def submit(self, cfg: FedConfig) -> str:
+    @staticmethod
+    def _is_solo(cfg: FedConfig) -> bool:
+        """Streamed cohorts and population meshes fall outside the batch
+        contract (validate_batch) — schedule them as solo single-lane
+        groups through the harness path instead of rejecting them."""
+        return cfg.cohort_size > 0 or cfg.pop_shards > 1
+
+    def _open_obs(self, run_id: str, cfg: FedConfig, title: str):
+        sink: obs_lib.EventSink = obs_lib.JsonlSink(
+            obs_lib.events_path(cfg.obs_dir, title)
+        )
+        if self.registry is not None:
+            labeled = obs_lib.LabeledRegistry(self.registry, run_id=run_id)
+            msink = obs_lib.MetricsSink(labeled)
+            # the watchdog's wedge threshold doubles as the per-run
+            # health bar (0 keeps per-sink wedge detection disabled)
+            msink.wedge_secs = self.wedge_secs
+            sink = obs_lib.MultiSink([sink, msink])
+        return obs_lib.Observability(sink)
+
+    def submit(
+        self,
+        cfg: FedConfig,
+        idempotency_key: Optional[str] = None,
+    ) -> str:
         """Register + queue one run; returns its server-assigned id.
 
-        The run's event stream opens HERE so ``run_submitted`` is the
-        stream's first event and a crash between submit and execution
-        still leaves an audit trail."""
+        The submission is journaled FIRST (write-ahead: the pre-namespace
+        config mapping, so a restarted server can rebuild the exact run
+        under the same id) and the run's event stream opens here so
+        ``run_submitted`` is the stream's first event — a crash between
+        submit and execution still leaves both an audit trail and a
+        recoverable queue entry.  Raises :class:`QueueFull` when a
+        ``queue_cap`` is set and that many runs are already queued."""
+        cfg_map = config_to_mapping(cfg)
         with self._lock:
+            if idempotency_key is not None and idempotency_key in self._idem:
+                return self._idem[idempotency_key]
+            if self.queue_cap > 0:
+                queued = sum(
+                    1 for r in self._runs.values() if r.status == "queued"
+                )
+                if queued >= self.queue_cap:
+                    raise QueueFull(
+                        f"queue full: {queued} runs already queued "
+                        f"(cap {self.queue_cap}); retry after the scheduler "
+                        "drains"
+                    )
             self._seq += 1
             run_id = f"run-{self._seq:04d}"
             cfg = harness.run_namespace(cfg, run_id, self.obs_root)
             run = Run(run_id, cfg, static_signature(cfg))
-            sink: obs_lib.EventSink = obs_lib.JsonlSink(
-                obs_lib.events_path(cfg.obs_dir, run.title)
+            run.solo = self._is_solo(cfg)
+            run.idempotency_key = idempotency_key
+            if idempotency_key is not None:
+                self._idem[idempotency_key] = run_id
+            self.journal.append(
+                "submitted",
+                run_id,
+                config=cfg_map,
+                signature=run.signature,
+                title=run.title,
+                solo=run.solo,
+                idempotency_key=idempotency_key,
             )
-            if self.registry is not None:
-                labeled = obs_lib.LabeledRegistry(self.registry, run_id=run_id)
-                sink = obs_lib.MultiSink(
-                    [sink, obs_lib.MetricsSink(labeled)]
-                )
-            run.obs = obs_lib.Observability(sink)
+            run.obs = self._open_obs(run_id, cfg, run.title)
             run.obs.emit(
                 "run_submitted",
                 run_id=run_id, title=run.title, signature=run.signature,
@@ -149,6 +259,17 @@ class RunManager:
             self._pending.append(run_id)
         self._wake.set()
         return run_id
+
+    def submit_idempotent(
+        self, cfg: FedConfig, key: Optional[str] = None
+    ) -> Tuple[str, bool]:
+        """Submit unless ``key`` was already used; returns ``(run_id,
+        created)`` so the HTTP layer can answer 200 instead of 201 on a
+        client retry."""
+        with self._lock:
+            if key is not None and key in self._idem:
+                return self._idem[key], False
+        return self.submit(cfg, idempotency_key=key), True
 
     def _get(self, run_id: str) -> Run:
         run = self._runs.get(run_id)
@@ -165,17 +286,21 @@ class RunManager:
             return [self._runs[rid].info() for rid in self._order]
 
     def cancel(self, run_id: str) -> Dict[str, Any]:
-        """Cancel a run.  Queued runs finalize immediately; running runs
-        go dark at the next round boundary (idempotent on done runs)."""
+        """Cancel a run.  Queued runs finalize immediately; running batch
+        lanes go dark at the next round boundary (idempotent on done
+        runs).  A running SOLO lane cannot be interrupted mid-schedule —
+        the cancel takes effect only if it is still queued."""
         with self._lock:
             run = self._get(run_id)
             if run.status in _DONE:
                 return run.info()
             run.cancel_requested = True
+            self._requeue_at.pop(run_id, None)
             if run.status == "queued":
                 run.status = "cancelled"
                 run.obs.emit("run_cancelled", run_id=run_id, round=0)
                 run.obs.close()
+                self.journal.append("cancelled", run_id, round=run.round)
             return run.info()
 
     def swap(self, run_id: str, knob: str, value) -> Dict[str, Any]:
@@ -213,12 +338,106 @@ class RunManager:
                 run.swaps.append((knob, value))
             return run.info()
 
+    # ---------------------------------------------------------- recovery
+
+    def recover(self, warn=None) -> List[str]:
+        """Replay the durable journal: re-adopt terminal runs as facts,
+        requeue in-flight runs to resume from their last checkpoint.
+        Returns the requeued ids.  Call BEFORE :meth:`start` on a
+        restarted server (ExperimentServer does)."""
+        warn = warn or _warn
+        states = journal_lib.replay(self.journal.path, warn=warn)
+        requeued: List[str] = []
+        with self._lock:
+            for run_id, st in states.items():
+                if run_id in self._runs:
+                    continue
+                try:
+                    num = int(run_id.rsplit("-", 1)[1])
+                except (IndexError, ValueError):
+                    num = 0
+                self._seq = max(self._seq, num)
+                try:
+                    cfg = config_from_mapping(dict(st["config"]))
+                except Exception as exc:
+                    warn(
+                        f"run {run_id}: journaled config no longer valid "
+                        f"({exc}); dropping"
+                    )
+                    continue
+                cfg = harness.run_namespace(cfg, run_id, self.obs_root)
+                run = Run(run_id, cfg, static_signature(cfg))
+                run.solo = self._is_solo(cfg)
+                key = st.get("idempotency_key")
+                if key:
+                    run.idempotency_key = key
+                    self._idem[key] = run_id
+                status = st["status"]
+                if status in _DONE:
+                    run.status = status
+                    run.round = (
+                        cfg.rounds if status == "completed"
+                        else int(st.get("round", 0))
+                    )
+                    run.lowerings = st.get("lowerings")
+                    run.error = st.get("error")
+                    if (
+                        st.get("final_val_acc") is not None
+                        or st.get("final_val_loss") is not None
+                    ):
+                        run.final = {
+                            "val_acc": st.get("final_val_acc"),
+                            "val_loss": st.get("final_val_loss"),
+                        }
+                else:
+                    run.retries = int(st.get("retries", 0))
+                    run.resume_round = self._probe_resume(run, warn)
+                    run.round = run.resume_round
+                    run.status = "queued"
+                    run.obs = self._open_obs(run_id, cfg, run.title)
+                    run.obs.emit(
+                        "journal_replay",
+                        run_id=run_id,
+                        status="resumed" if run.resume_round else "restarted",
+                        round=run.resume_round,
+                    )
+                    self._pending.append(run_id)
+                    requeued.append(run_id)
+                self._runs[run_id] = run
+                self._order.append(run_id)
+        if requeued:
+            self._wake.set()
+        return requeued
+
+    def _probe_resume(self, run: Run, warn=_warn) -> int:
+        """The round this run can durably resume from — 0 when there is
+        no usable checkpoint (absent, torn, or missing the paths meta a
+        full-record batch resume needs; restarting from scratch replays
+        the identical trajectory, it just costs recompute)."""
+        try:
+            restored = checkpoint.load(run.cfg.checkpoint_dir, run.title)
+            if restored is None:
+                return 0
+            if not run.solo:
+                meta = checkpoint.load_meta(run.cfg.checkpoint_dir, run.title)
+                if meta is None:
+                    return 0
+            return int(restored[0])
+        except Exception as exc:
+            warn(
+                f"run {run.run_id}: unreadable checkpoint "
+                f"({type(exc).__name__}: {exc}); restarting from round 0"
+            )
+            return 0
+
     # --------------------------------------------------------- scheduler
 
     def start(self) -> "RunManager":
         """Start the background scheduler (the server's mode).  Waits
         ``batch_window`` seconds after a submission before draining so
-        concurrent tenants coalesce into one batch."""
+        concurrent tenants coalesce into one batch.  With
+        ``wedge_secs > 0`` a watchdog thread also starts, requeueing
+        wedged runs with bounded retries."""
         with self._lock:
             if self._thread is None:
                 self._stop = False
@@ -228,6 +447,13 @@ class RunManager:
                     daemon=True,
                 )
                 self._thread.start()
+            if self.wedge_secs > 0 and self._watchdog is None:
+                self._watchdog = threading.Thread(
+                    target=self._watchdog_loop,
+                    name="aircomp-run-watchdog",
+                    daemon=True,
+                )
+                self._watchdog.start()
         return self
 
     def close(self) -> None:
@@ -237,11 +463,16 @@ class RunManager:
         if thread is not None:
             thread.join(timeout=30.0)
             self._thread = None
+        watchdog = self._watchdog
+        if watchdog is not None:
+            watchdog.join(timeout=5.0)
+            self._watchdog = None
         with self._lock:
             for rid in self._order:
                 run = self._runs[rid]
                 if run.status not in _DONE:
                     run.obs.close()
+        self.journal.close()
 
     def _loop(self) -> None:
         while not self._stop:
@@ -257,10 +488,11 @@ class RunManager:
                     traceback.print_exc()  # their own failure status
 
     def drain(self) -> None:
-        """Execute every currently-queued run, grouped by signature into
-        one BatchRunner per group.  Blocks until done.  Tests call this
-        directly for deterministic grouping; the scheduler thread calls
-        it after the batch window."""
+        """Execute every currently-queued run: solo configs one at a
+        time, batchable ones grouped by ``(signature, resume_round)``
+        into one BatchRunner per group.  Blocks until done.  Tests call
+        this directly for deterministic grouping; the scheduler thread
+        calls it after the batch window."""
         while True:
             with self._lock:
                 pending = [
@@ -269,14 +501,25 @@ class RunManager:
                     if self._runs[rid].status == "queued"
                 ]
                 self._pending = []
-                groups: Dict[str, List[Run]] = {}
+                solos: List[Run] = []
+                groups: Dict[Tuple[str, int], List[Run]] = {}
                 for run in pending:
                     run.status = "running"
-                    groups.setdefault(run.signature, []).append(run)
-            if not groups:
+                    run.attempt += 1
+                    run.last_progress = time.time()
+                    self.journal.append("running", run.run_id)
+                    if run.solo:
+                        solos.append(run)
+                    else:
+                        groups.setdefault(
+                            (run.signature, run.resume_round), []
+                        ).append(run)
+            if not groups and not solos:
                 return
             for runs in groups.values():
                 self._run_group(runs)
+            for run in solos:
+                self._run_solo(run)
 
     def _dataset_for(self, name: str):
         if self._dataset is not None:
@@ -293,38 +536,112 @@ class RunManager:
                 if run.status not in _DONE:
                     run.status = "failed"
                     run.error = f"{type(exc).__name__}: {exc}"
+                    run.obs.emit(
+                        "run_failed",
+                        run_id=run.run_id, round=run.round, reason=run.error,
+                    )
+                    self.journal.append(
+                        "failed", run.run_id,
+                        round=run.round, reason=run.error,
+                    )
                 run.obs.close()
 
+    def _load_group_resume(
+        self, runs: List[Run], resume_round: int
+    ) -> Tuple[int, List[Optional[tuple]], List[Optional[Dict[str, list]]]]:
+        """Load every lane's checkpoint for a resuming group.  All-or-
+        nothing: if ANY lane's checkpoint is unusable the whole group
+        restarts from round 0 (a fresh replay is bit-identical by the
+        fold_in key discipline — correctness never depends on the
+        checkpoint, only wall-clock does)."""
+        if resume_round <= 0:
+            return 0, [None] * len(runs), [None] * len(runs)
+        restores: List[Optional[tuple]] = []
+        paths: List[Optional[Dict[str, list]]] = []
+        for run in runs:
+            try:
+                restored = checkpoint.load(run.cfg.checkpoint_dir, run.title)
+                meta = checkpoint.load_meta(run.cfg.checkpoint_dir, run.title)
+            except Exception as exc:
+                _warn(
+                    f"run {run.run_id}: checkpoint unreadable at group time "
+                    f"({type(exc).__name__}: {exc}); group restarts fresh"
+                )
+                restored, meta = None, None
+            if (
+                restored is None
+                or int(restored[0]) != resume_round
+                or meta is None
+            ):
+                return 0, [None] * len(runs), [None] * len(runs)
+            restores.append(restored)
+            paths.append(json.loads(meta))
+        return resume_round, restores, paths
+
     def _run_group(self, runs: List[Run]) -> None:
+        resume_round, restores, resume_paths = self._load_group_resume(
+            runs, runs[0].resume_round
+        )
         try:
             dataset = self._dataset_for(runs[0].cfg.dataset)
+
+            def restore_fn(lane: int, trainer) -> None:
+                if restores[lane] is not None:
+                    harness.restore_trainer(
+                        trainer, runs[lane].cfg, restores[lane], log_fn=_warn
+                    )
+
             batch = BatchRunner(
                 [r.cfg for r in runs],
                 dataset=dataset,
                 backend=self._backend,
+                restore_fn=restore_fn if resume_round > 0 else None,
             )
         except Exception as exc:
             self._fail(runs, exc)
             return
+        attempts = {run.run_id: run.attempt for run in runs}
+        lane_of = {run.run_id: lane for lane, run in enumerate(runs)}
         with self._lock:
             for lane, run in enumerate(runs):
                 run.lane = lane
 
+        def _live(run: Run) -> bool:
+            """Still this group's run?  A watchdog requeue bumps the
+            attempt — the stale group must stop touching it."""
+            return (
+                run.status == "running"
+                and run.attempt == attempts[run.run_id]
+            )
+
         def before_round(rnd: int) -> None:
             with self._lock:
                 for run in runs:
-                    if run.status != "running":
+                    lane = lane_of[run.run_id]
+                    if not _live(run):
+                        if batch.active[lane]:
+                            batch.cancel(lane)
+                        continue
+                    if run.wedged:
+                        # the watchdog owns this run now (requeue or
+                        # terminal failure) — this group just stops
+                        # driving the lane, without terminalizing
+                        batch.cancel(lane)
                         continue
                     if run.cancel_requested:
-                        batch.cancel(run.lane)
+                        batch.cancel(lane)
                         run.status = "cancelled"
                         run.obs.emit(
                             "run_cancelled", run_id=run.run_id, round=rnd
                         )
+                        run.obs.close()
+                        self.journal.append(
+                            "cancelled", run.run_id, round=rnd
+                        )
                         run.swaps = []
                         continue
                     for knob, value in run.swaps:
-                        batch.set_knob(run.lane, knob, value)
+                        batch.set_knob(lane, knob, value)
                         setattr(run.cfg, knob, value)
                         run.applied_swaps.append(
                             {"round": rnd, "knob": knob, "value": value}
@@ -336,21 +653,288 @@ class RunManager:
                         )
                     run.swaps = []
                     run.round = rnd
+                    run.last_progress = time.time()
+
+        def on_quarantine(lane: int, rnd: int, reason: str) -> None:
+            with self._lock:
+                run = runs[lane]
+                if not _live(run):
+                    return
+                run.status = "failed"
+                run.error = f"quarantined: {reason}"
+                run.round = rnd
+                run.obs.emit(
+                    "run_failed",
+                    run_id=run.run_id, round=rnd, reason=run.error,
+                )
+                run.obs.close()
+                self.journal.append(
+                    "failed", run.run_id, round=rnd, reason=run.error
+                )
+
+        def after_round(rnd: int) -> None:
+            # durable per-round progress: params + opt carries + the
+            # metric paths so far, one atomic npz per live lane — the
+            # unit a restarted server resumes from
+            with self._lock:
+                for run in runs:
+                    lane = lane_of[run.run_id]
+                    if not _live(run) or not batch.active[lane]:
+                        continue
+                    flat, extras = batch.lane_state(lane)
+                    try:
+                        checkpoint.save(
+                            run.cfg.checkpoint_dir,
+                            run.title,
+                            rnd + 1,
+                            flat,
+                            extras,
+                            meta=json.dumps(batch.paths_list[lane]),
+                        )
+                    except Exception as exc:
+                        _warn(
+                            f"run {run.run_id}: checkpoint write failed "
+                            f"({type(exc).__name__}: {exc}); continuing"
+                        )
+                        continue
+                    self.journal.append(
+                        "checkpoint", run.run_id, round=rnd + 1
+                    )
+                    run.round = rnd + 1
+                    run.last_progress = time.time()
 
         try:
             paths_list = batch.train(
                 obs_list=[r.obs for r in runs],
+                start_round=resume_round,
                 before_round=before_round,
+                after_round=after_round,
+                resume_paths=resume_paths,
+                on_quarantine=on_quarantine,
             )
         except Exception as exc:
             self._fail(runs, exc)
             return
         lowerings = batch.retrace.count("batch_round_fn")
+        dataset = self._dataset_for(runs[0].cfg.dataset)
         with self._lock:
             for run, paths in zip(runs, paths_list):
+                if not _live(run) or run.wedged:
+                    # wedged runs belong to the watchdog now (their lane
+                    # went dark mid-schedule, so these paths are partial)
+                    if run.status in _DONE:
+                        run.lowerings = run.lowerings or lowerings
+                    continue
                 run.paths = paths
                 run.lowerings = lowerings
-                if run.status == "running":
-                    run.status = "completed"
-                    run.round = run.cfg.rounds
+                run.status = "completed"
+                run.wedged = False
+                run.round = run.cfg.rounds
+                record = harness.build_record(
+                    run.cfg,
+                    paths,
+                    dataset_name=dataset.name,
+                    dataset_size=len(dataset.x_train),
+                    max_feature=int(dataset.x_train[0].size),
+                )
+                try:
+                    run.record_path = io_lib.atomic_pickle(
+                        harness.cache_path(run.cfg, dataset.name), record
+                    )
+                except Exception as exc:
+                    _warn(
+                        f"run {run.run_id}: record write failed "
+                        f"({type(exc).__name__}: {exc})"
+                    )
+                self.journal.append(
+                    "completed",
+                    run.run_id,
+                    round=run.round,
+                    lowerings=lowerings,
+                    final_val_acc=paths["valAccPath"][-1],
+                    final_val_loss=paths["valLossPath"][-1],
+                )
                 run.obs.close()
+
+    def _run_solo(self, run: Run) -> None:
+        """One streamed/mesh tenant through the ordinary harness path —
+        a single-lane group.  The harness reopens the run's event stream
+        (seq continues from the file), checkpoints every round with the
+        metric paths riding the npz (``persist_paths``), and on an
+        ``inherit`` resume merges the prefix so the record covers the
+        whole schedule."""
+        run_id = run.run_id
+        with self._lock:
+            run.lane = 0
+            run.last_progress = time.time()
+        # hand the stream over: the harness's own sink appends after ours
+        run.obs.close()
+        solo_cfg = dataclasses.replace(run.cfg, inherit=True)
+
+        def on_ckpt(rnd: int) -> None:
+            self.journal.append("checkpoint", run_id, round=rnd)
+            with self._lock:
+                run.round = rnd
+                run.last_progress = time.time()
+
+        try:
+            record = harness.run(
+                solo_cfg,
+                record_in_file=True,
+                persist_paths=True,
+                on_checkpoint=on_ckpt,
+            )
+        except Exception as exc:
+            err = f"{type(exc).__name__}: {exc}"
+            with self._lock:
+                run.status = "failed"
+                run.error = err
+                obs = self._open_obs(run_id, run.cfg, run.title)
+                obs.emit(
+                    "run_failed", run_id=run_id, round=run.round, reason=err
+                )
+                obs.close()
+                run.obs = obs_lib.NULL
+            self.journal.append(
+                "failed", run_id, round=run.round, reason=err
+            )
+            return
+        lowerings = self._solo_lowerings(run.cfg, run.title)
+        with self._lock:
+            run.paths = {
+                k: v
+                for k, v in record.items()
+                if isinstance(v, list)
+            }
+            run.lowerings = lowerings
+            run.status = "completed"
+            run.round = run.cfg.rounds
+            run.record_path = harness.cache_path(run.cfg, record["name"])
+            run.obs = obs_lib.NULL
+        self.journal.append(
+            "completed",
+            run_id,
+            round=run.cfg.rounds,
+            lowerings=lowerings,
+            final_val_acc=record["valAccPath"][-1],
+            final_val_loss=record["valLossPath"][-1],
+        )
+
+    def _solo_lowerings(self, cfg: FedConfig, title: str) -> Optional[int]:
+        """The solo round fn's lowering count, read back from the run's
+        own retrace event (the harness emits it at run end)."""
+        path = obs_lib.events_path(cfg.obs_dir, title)
+        count: Optional[int] = None
+        for e in io_lib.iter_jsonl(path):
+            if e.get("kind") == "retrace":
+                counts = e.get("counts") or {}
+                if counts.get("round_fn") is not None:
+                    count = int(counts["round_fn"])
+        return count
+
+    # ---------------------------------------------------------- watchdog
+
+    def degraded(self) -> Optional[str]:
+        """A human-readable reason when the service is degraded (wedged
+        or backoff-pending runs), else None — the server's /healthz
+        flips to 503 on it."""
+        with self._lock:
+            wedged = [
+                rid
+                for rid in self._order
+                if self._runs[rid].wedged
+                and self._runs[rid].status not in _DONE
+            ]
+            if wedged:
+                return f"wedged runs: {', '.join(wedged)}"
+            if self._requeue_at:
+                return (
+                    "requeue pending: "
+                    + ", ".join(sorted(self._requeue_at))
+                )
+        return None
+
+    def _watchdog_loop(self) -> None:
+        interval = max(min(self.wedge_secs / 4.0, 0.5), 0.05)
+        while not self._stop:
+            time.sleep(interval)
+            try:
+                self._watchdog_sweep(time.time())
+            except Exception:
+                traceback.print_exc()
+
+    def _watchdog_sweep(self, now: float) -> None:
+        """One supervision pass (explicit ``now`` so tests drive it
+        deterministically): flag running runs with no progress in
+        ``wedge_secs`` as wedged, cancel their lane, and either schedule
+        a bounded-backoff requeue (``run_backoff * 2**(retries-1)``
+        seconds) or — retries exhausted — fail them for good.  Solo
+        lanes are flagged (degrading /healthz) but never requeued while
+        their executing thread may still be alive: a second execution
+        over the same namespace would race the first."""
+        wake = False
+        with self._lock:
+            for rid in self._order:
+                run = self._runs[rid]
+                if (
+                    run.status != "running"
+                    or run.wedged
+                    or self.wedge_secs <= 0
+                ):
+                    continue
+                age = now - run.last_progress
+                if age <= self.wedge_secs:
+                    continue
+                run.wedged = True
+                run.cancel_requested = True  # lane goes dark if it wakes
+                reason = f"wedged: no progress in {age:.1f}s"
+                if run.solo:
+                    _warn(
+                        f"run {rid} {reason} (solo lane — flagged, not "
+                        "requeued; /healthz reports degraded)"
+                    )
+                    continue
+                if run.retries < self.run_retries:
+                    run.retries += 1
+                    delay = self.run_backoff * (2 ** (run.retries - 1))
+                    self._requeue_at[rid] = now + delay
+                    run.obs.emit(
+                        "run_requeued",
+                        run_id=rid, round=run.round,
+                        retries=run.retries, reason=reason,
+                    )
+                    self.journal.append(
+                        "requeued", rid, retries=run.retries, reason=reason
+                    )
+                    _warn(
+                        f"run {rid} {reason}; requeue "
+                        f"{run.retries}/{self.run_retries} in {delay:.1f}s"
+                    )
+                else:
+                    run.status = "failed"
+                    run.error = f"{reason}; retries exhausted"
+                    run.obs.emit(
+                        "run_failed",
+                        run_id=rid, round=run.round, reason=run.error,
+                    )
+                    run.obs.close()
+                    self.journal.append(
+                        "failed", rid, round=run.round, reason=run.error
+                    )
+            for rid, due in sorted(self._requeue_at.items()):
+                if now < due:
+                    continue
+                del self._requeue_at[rid]
+                run = self._runs[rid]
+                if run.status in _DONE:
+                    continue
+                run.status = "queued"
+                run.wedged = False
+                run.cancel_requested = False
+                run.last_progress = now
+                run.resume_round = self._probe_resume(run)
+                run.round = run.resume_round
+                self._pending.append(rid)
+                wake = True
+        if wake:
+            self._wake.set()

@@ -34,7 +34,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from .. import obs as obs_lib
 from ..fed.config import config_from_mapping
-from .runs import RunManager
+from .runs import QueueFull, RunManager
 
 _JSON = "application/json"
 
@@ -50,6 +50,11 @@ class ExperimentServer:
         dataset=None,
         backend: str = "vmap",
         batch_window: float = 0.25,
+        queue_cap: int = 0,
+        run_retries: int = 1,
+        run_backoff: float = 2.0,
+        wedge_secs: float = 0.0,
+        recover: bool = True,
     ) -> None:
         self.registry = obs_lib.MetricsRegistry()
         self.manager = RunManager(
@@ -58,7 +63,16 @@ class ExperimentServer:
             dataset=dataset,
             backend=backend,
             batch_window=batch_window,
+            queue_cap=queue_cap,
+            run_retries=run_retries,
+            run_backoff=run_backoff,
+            wedge_secs=wedge_secs,
         )
+        if recover:
+            # replay the durable journal BEFORE serving: terminal runs
+            # are re-adopted as facts, in-flight runs requeue and resume
+            # from their last checkpoint (docs/RUNBOOK.md)
+            self.manager.recover()
         self.exporter = obs_lib.MetricsExporter(
             self.registry,
             port=port,
@@ -92,7 +106,13 @@ class ExperimentServer:
         counts: Dict[str, int] = {}
         for info in self.manager.list_runs():
             counts[info["status"]] = counts.get(info["status"], 0) + 1
-        return {"ok": True, "runs": counts}
+        reason = self.manager.degraded()
+        body: Dict[str, Any] = {"ok": reason is None, "runs": counts}
+        if reason is not None:
+            # the exporter maps ok=False to HTTP 503 — a wedged run
+            # degrades the whole service until requeued or failed
+            body["reason"] = reason
+        return body
 
     @staticmethod
     def _json(status: int, payload: Any) -> Tuple[int, str, bytes]:
@@ -107,6 +127,8 @@ class ExperimentServer:
             return self._dispatch(method, path, body)
         except KeyError as exc:
             return self._json(404, {"error": str(exc).strip("'\"")})
+        except QueueFull as exc:  # backpressure, not a client error
+            return self._json(429, {"error": str(exc)})
         except ValueError as exc:  # includes json.JSONDecodeError
             return self._json(400, {"error": str(exc)})
         except Exception as exc:  # noqa: BLE001 — surface, don't kill the thread
@@ -129,8 +151,16 @@ class ExperimentServer:
                         "POST /runs body must be a JSON object of "
                         "FedConfig overrides"
                     )
-                run_id = mgr.submit(config_from_mapping(overrides))
-                return self._json(201, mgr.get(run_id))
+                # a client-supplied idempotency key makes submit retries
+                # safe: the same key returns the original run (200), a
+                # fresh key creates one (201)
+                key = overrides.pop("idempotency_key", None)
+                if key is not None and not isinstance(key, str):
+                    raise ValueError("idempotency_key must be a string")
+                run_id, created = mgr.submit_idempotent(
+                    config_from_mapping(overrides), key=key
+                )
+                return self._json(201 if created else 200, mgr.get(run_id))
             if method == "GET":
                 return self._json(200, {"runs": mgr.list_runs()})
         elif len(parts) == 2 and method == "GET":

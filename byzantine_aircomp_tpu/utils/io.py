@@ -11,10 +11,11 @@ audited implementation.
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import tempfile
-from typing import Any, Callable
+from typing import Any, Callable, Iterator, Optional
 
 
 def atomic_write(path: str, write_fn: Callable, mode: str = "wb") -> str:
@@ -54,3 +55,35 @@ def open_append(path: str):
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     return open(path, "a", buffering=1)
+
+
+def iter_jsonl(
+    path: str, warn: Optional[Callable[[str], None]] = None
+) -> Iterator[dict]:
+    """Yield parsed objects from a JSONL file, skipping undecodable lines.
+
+    The read-side counterpart to :func:`open_append`: line-buffered
+    appends mean a kill can tear AT MOST the final line (a partial write
+    the OS flushed on process death), so a loader that raised on it would
+    turn one lost line into a lost stream.  Every torn/garbage line is
+    skipped through ``warn`` (once per line); byte truncation that splits
+    a multibyte character is absorbed by ``errors="replace"``.  A missing
+    file yields nothing — callers distinguish empty from absent with
+    ``os.path.exists`` if they care."""
+    if not os.path.exists(path):
+        return
+    with open(path, "r", errors="replace") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                if warn is not None:
+                    warn(f"skipping malformed line {i + 1} of {path}")
+                continue
+            if isinstance(obj, dict):
+                yield obj
+            elif warn is not None:
+                warn(f"skipping non-object line {i + 1} of {path}")

@@ -1,0 +1,325 @@
+"""Crash-safety building blocks: torn-tail tolerance, the durable run
+journal, checkpoint paths-meta, config round-trip, and the watchdog's
+bounded retries.
+
+The end-to-end invariants (kill -9 a real server, restart, bit-identical
+records) live in tests/test_serve.py::test_server_resume_bit_identity...
+and in the CI chaos-smoke job (analysis/chaos.py); this file pins the
+pieces those compose — each failure mode in isolation, cheap enough for
+tier 1.  docs/RUNBOOK.md is the operator-facing story.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu.fed.config import (
+    FedConfig, config_from_mapping, config_to_mapping,
+)
+from byzantine_aircomp_tpu.utils.io import iter_jsonl
+
+
+def _cfg(**kw):
+    base = dict(
+        dataset="mnist", honest_size=6, byz_size=0, rounds=2,
+        display_interval=2, batch_size=16, agg="mean", eval_train=False,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+# ----------------------------------------------------- torn-tail loaders
+
+
+def test_iter_jsonl_skips_torn_tail(tmp_path):
+    """A SIGKILL mid-append tears at most the final line; the loader
+    yields every intact object and warns once per torn line."""
+    p = tmp_path / "stream.jsonl"
+    with open(p, "wb") as f:
+        f.write(b'{"kind": "a", "n": 1}\n')
+        f.write(b'{"kind": "b", "n": 2}\n')
+        f.write(b'{"kind": "c", "n"')  # torn: no closing brace, no newline
+    warnings = []
+    rows = list(iter_jsonl(str(p), warn=warnings.append))
+    assert [r["kind"] for r in rows] == ["a", "b"]
+    assert len(warnings) == 1 and "line 3" in warnings[0]
+
+
+def test_iter_jsonl_missing_file_and_non_objects(tmp_path):
+    assert list(iter_jsonl(str(tmp_path / "absent.jsonl"))) == []
+    p = tmp_path / "mixed.jsonl"
+    p.write_text('{"ok": 1}\n[1, 2]\n\n{"ok": 2}\n')
+    warnings = []
+    rows = list(iter_jsonl(str(p), warn=warnings.append))
+    assert [r["ok"] for r in rows] == [1, 2]  # array line skipped, blank ok
+    assert len(warnings) == 1
+
+
+def test_load_events_tolerates_byte_truncated_stream(tmp_path):
+    """A killed run's event stream — byte-truncated mid-line, no
+    run_end — still loads as a valid prefix (satellite: the analysis
+    loaders must never raise on what a crash legitimately leaves)."""
+    from byzantine_aircomp_tpu.analysis.defense_trace import load_events
+    from byzantine_aircomp_tpu.obs import events as events_lib
+
+    p = tmp_path / "run.events.jsonl"
+    full = [
+        events_lib.make_event("run_start", title="t", backend="jit",
+                              rounds=4, start_round=0),
+        events_lib.make_event("round", round=0, val_loss=1.0, val_acc=0.5,
+                              variance=0.1),
+        events_lib.make_event("round", round=1, val_loss=0.9, val_acc=0.6,
+                              variance=0.1),
+    ]
+    with open(p, "w") as f:
+        for e in full:
+            f.write(json.dumps(e) + "\n")
+    # byte-truncate the tail mid-line, as a kill mid-write would
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.truncate(size - 17)
+    events = load_events(str(p))
+    assert [e["kind"] for e in events] == ["run_start", "round"]
+    assert not any(e["kind"] == "run_end" for e in events)  # fine
+
+
+# ----------------------------------------------------- config round-trip
+
+
+def test_config_to_mapping_round_trips():
+    """The journal stores configs as non-default mappings; replay must
+    rebuild the EXACT config (the config_hash contract rides on it)."""
+    cases = [
+        _cfg(),
+        _cfg(seed=7, gamma=0.5, rounds=9),
+        _cfg(byz_size=2, attack="signflip", defense="adaptive",
+             defense_ladder="mean,trimmed_mean,median"),
+        _cfg(honest_size=12, byz_size=4, agg="median", attack="gaussian",
+             noise_var=0.1, service="on", population=48,
+             churn_arrival=0.05, churn_departure=0.02,
+             straggler_prob=0.2, cohort_size=2, pop_shards=8),
+    ]
+    for cfg in cases:
+        mapping = config_to_mapping(cfg)
+        # only non-default fields are stored (the journal stays readable)
+        assert "model" not in mapping or cfg.model != FedConfig().model
+        rebuilt = config_from_mapping(json.loads(json.dumps(mapping)))
+        assert rebuilt == cfg
+
+
+# ----------------------------------------------------- journal replay
+
+
+def test_journal_replay_folds_lifecycle(tmp_path):
+    from byzantine_aircomp_tpu.serve import journal as journal_lib
+
+    path = str(tmp_path / "journal.jsonl")
+    j = journal_lib.RunJournal(path)
+    cfg_map = config_to_mapping(_cfg(seed=1))
+    # run-0001: completed
+    j.append("submitted", "run-0001", config=cfg_map, signature="sig",
+             title="t1", solo=False, idempotency_key="key-1")
+    j.append("running", "run-0001")
+    j.append("checkpoint", "run-0001", round=1)
+    j.append("checkpoint", "run-0001", round=2)
+    j.append("completed", "run-0001", round=2, lowerings=1,
+             final_val_acc=0.9, final_val_loss=0.3)
+    # run-0002: in flight (crash mid-run) with one requeue behind it
+    j.append("submitted", "run-0002", config=cfg_map, signature="sig",
+             title="t2", solo=False, idempotency_key=None)
+    j.append("running", "run-0002")
+    j.append("checkpoint", "run-0002", round=1)
+    j.append("requeued", "run-0002", retries=1, reason="wedged")
+    j.append("running", "run-0002")
+    # run-0003: failed (quarantined)
+    j.append("submitted", "run-0003", config=cfg_map, signature="sig",
+             title="t3", solo=True)
+    j.append("running", "run-0003")
+    j.append("failed", "run-0003", round=1,
+             reason="quarantined: non-finite parameters")
+    j.close()
+    # tear the tail: a half-written checkpoint line
+    with open(path, "ab") as f:
+        f.write(b'{"op": "checkpoint", "run_id": "run-0002", "rou')
+
+    warnings = []
+    states = journal_lib.replay(path, warn=warnings.append)
+    assert sorted(states) == ["run-0001", "run-0002", "run-0003"]
+    s1, s2, s3 = (states[f"run-000{i}"] for i in (1, 2, 3))
+    assert s1["status"] == "completed" and s1["lowerings"] == 1
+    assert s1["final_val_acc"] == 0.9
+    assert s1["idempotency_key"] == "key-1"
+    assert s2["status"] == "queued"  # in flight -> requeue on replay
+    assert s2["round"] == 1 and s2["retries"] == 1
+    assert s3["status"] == "failed" and s3["solo"] is True
+    assert "quarantined" in s3["error"]
+    assert config_from_mapping(dict(s1["config"])) == _cfg(seed=1)
+    assert len(warnings) == 1  # the torn line, once
+
+
+def test_journal_replay_drops_configless_run(tmp_path):
+    """A run whose 'submitted' line was itself the torn tail is
+    unrecoverable — replay drops it with a warning, never raises."""
+    from byzantine_aircomp_tpu.serve import journal as journal_lib
+
+    path = str(tmp_path / "journal.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"op": "running", "run_id": "run-0009"}) + "\n")
+    warnings = []
+    states = journal_lib.replay(path, warn=warnings.append)
+    assert states == {}
+    assert any("run-0009" in w for w in warnings)
+
+
+def test_journal_replay_missing_file(tmp_path):
+    from byzantine_aircomp_tpu.serve import journal as journal_lib
+
+    assert journal_lib.replay(str(tmp_path / "absent.jsonl")) == {}
+
+
+# ----------------------------------------------------- checkpoint meta
+
+
+def test_checkpoint_meta_rides_the_same_atomic_write(tmp_path):
+    from byzantine_aircomp_tpu.fed import checkpoint
+
+    paths = {"valLossPath": [1.25, 0.5], "variencePath": [0.125]}
+    checkpoint.save(
+        str(tmp_path), "t", 2,
+        np.zeros(3, np.float32), [np.ones(2, np.float32)],
+        meta=json.dumps(paths),
+    )
+    # the paths meta is there, bit-exact through the JSON round-trip
+    meta = checkpoint.load_meta(str(tmp_path), "t")
+    assert json.loads(meta) == paths
+    # and the ordinary loader is oblivious to it (old-reader compat)
+    rnd, flat, extras = checkpoint.load(str(tmp_path), "t")
+    assert rnd == 2 and flat.shape == (3,) and len(extras) == 1
+    # absent meta -> None, absent file -> None
+    checkpoint.save(
+        str(tmp_path), "bare", 1, np.zeros(1, np.float32), []
+    )
+    assert checkpoint.load_meta(str(tmp_path), "bare") is None
+    assert checkpoint.load_meta(str(tmp_path), "absent") is None
+
+
+# ----------------------------------------------------- watchdog
+
+
+@pytest.fixture
+def synthetic_mnist(monkeypatch):
+    import byzantine_aircomp_tpu.data.datasets as dl
+
+    orig = dl.load
+    monkeypatch.setattr(
+        dl, "load",
+        lambda name, **kw: orig(name, synthetic_train=600, synthetic_val=200),
+    )
+
+
+def test_watchdog_bounded_retries(tmp_path, synthetic_mnist):
+    """The supervision state machine, driven deterministically through
+    _watchdog_sweep(now): a wedged run is requeued with exponential
+    backoff at most run_retries times, then failed for good — never a
+    requeue storm."""
+    from byzantine_aircomp_tpu.serve import journal as journal_lib
+    from byzantine_aircomp_tpu.serve.runs import RunManager
+
+    mgr = RunManager(
+        str(tmp_path / "root"),
+        wedge_secs=10.0, run_retries=2, run_backoff=5.0,
+    )
+    rid = mgr.submit(_cfg(seed=1))
+    run = mgr._runs[rid]
+
+    def wedge_at(t0):
+        run.status = "running"
+        run.wedged = False
+        run.last_progress = t0
+
+    wedge_at(0.0)
+    mgr._watchdog_sweep(5.0)  # within wedge_secs: healthy
+    assert run.status == "running" and not run.wedged
+    assert mgr.degraded() is None
+
+    mgr._watchdog_sweep(100.0)  # wedged -> retry 1, backoff 5s
+    assert run.wedged and run.retries == 1
+    assert run.status == "running"  # requeue not due yet
+    assert "wedged" in mgr.degraded()
+    # sweeping again while wedged must NOT consume more retries
+    mgr._watchdog_sweep(101.0)
+    mgr._watchdog_sweep(102.0)
+    assert run.retries == 1
+
+    mgr._watchdog_sweep(105.1)  # past the 5s backoff: requeued
+    assert run.status == "queued" and not run.wedged
+    assert rid in mgr._pending
+
+    wedge_at(200.0)
+    mgr._watchdog_sweep(300.0)  # wedged again -> retry 2, backoff 10s
+    assert run.retries == 2
+    mgr._watchdog_sweep(305.0)  # 5s < 10s: not due
+    assert run.status == "running"
+    mgr._watchdog_sweep(310.1)
+    assert run.status == "queued"
+
+    wedge_at(400.0)
+    mgr._watchdog_sweep(500.0)  # retries exhausted -> terminal failure
+    assert run.status == "failed"
+    assert "retries exhausted" in run.error
+    assert mgr.degraded() is None  # terminal runs no longer degrade
+    mgr._watchdog_sweep(600.0)  # idempotent on done runs
+    assert run.status == "failed" and run.retries == 2
+
+    mgr.journal.close()
+    ops = [
+        (r["op"], r.get("retries"))
+        for r in iter_jsonl(journal_lib.journal_path(str(tmp_path / "root")))
+        if r["run_id"] == rid
+    ]
+    assert ops.count(("requeued", 1)) == 1
+    assert ops.count(("requeued", 2)) == 1
+    assert [o for o, _ in ops].count("failed") == 1
+    # the audit stream got exactly two run_requeued and one run_failed
+    run_dir = tmp_path / "root" / rid
+    events_file = next(
+        f for f in os.listdir(run_dir) if f.endswith(".events.jsonl")
+    )
+    kinds = [json.loads(l)["kind"] for l in open(run_dir / events_file)]
+    assert kinds.count("run_requeued") == 2
+    assert kinds.count("run_failed") == 1
+
+
+def test_health_degrades_while_wedged(tmp_path, synthetic_mnist):
+    """/healthz flips to ok=False (the exporter maps it to 503) while a
+    run is wedged, with an explanatory reason — and the healthy body
+    shape is unchanged."""
+    from byzantine_aircomp_tpu.serve.server import ExperimentServer
+
+    srv = ExperimentServer(
+        str(tmp_path / "root"), port=0, host="127.0.0.1",
+        wedge_secs=10.0, run_retries=0,
+    )
+    try:
+        body = srv._health()
+        assert body == {"ok": True, "runs": {}}  # shape unchanged
+        rid = srv.manager.submit(_cfg(seed=1))
+        run = srv.manager._runs[rid]
+        run.status = "running"
+        run.last_progress = 0.0
+        srv.manager._watchdog_sweep(100.0)  # retries=0 -> straight to failed
+        assert run.status == "failed"
+        run2 = srv.manager._runs[srv.manager.submit(_cfg(seed=2))]
+        run2.status = "running"
+        run2.last_progress = 0.0
+        srv.manager.run_retries = 1
+        srv.manager._watchdog_sweep(100.0)
+        assert run2.wedged
+        body = srv._health()
+        assert body["ok"] is False
+        assert "wedged" in body["reason"] or "requeue" in body["reason"]
+    finally:
+        srv.manager.close()

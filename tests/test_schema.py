@@ -49,6 +49,10 @@ GOLDEN = {
     # v4's, but the version bump is real: consumers merging multi-host
     # streams key on (host_id, seq) from v5 on
     5: "1e58b7097dea230e",
+    # v6 added the crash-safe serving kinds run_failed / run_requeued /
+    # journal_replay (lane quarantine, watchdog requeue, journal replay
+    # adoption — serve/runs.py, serve/journal.py, docs/RUNBOOK.md)
+    6: "dc708831ebabb12d",
 }
 
 

@@ -203,6 +203,218 @@ def test_concurrent_submits_isolated_namespaces(tmp_path, synthetic_mnist):
         assert sum(e["kind"] == "round" for e in lines) == 2
 
 
+def test_quarantine_isolates_poisoned_lane(tmp_path, synthetic_mnist):
+    """Acceptance bar (PR 14): N=8 with one poisoned tenant — the 7
+    healthy lanes finish bit-identical to a batch that never contained
+    it, zero relowerings, and the poisoned run fails with exactly one
+    run_failed event naming the quarantine reason."""
+    from byzantine_aircomp_tpu.serve.runs import RunManager
+
+    healthy_seeds = [0, 1, 2, 4, 5, 6, 7]
+    mgr = RunManager(str(tmp_path / "root"))
+    ids = {}
+    for s in range(8):
+        kw = dict(rounds=4, seed=s)
+        if s == 3:
+            kw["gamma"] = 1e38  # divergent step size: params go non-finite
+        ids[s] = mgr.submit(_cfg(**kw))
+    mgr.drain()
+    poisoned = mgr.get(ids[3])
+    assert poisoned["status"] == "failed"
+    assert poisoned["error"].startswith("quarantined:")
+    for s in healthy_seeds:
+        info = mgr.get(ids[s])
+        assert info["status"] == "completed", info
+        assert info["lowerings"] == 1  # quarantine never retraces
+    # exactly one run_failed event in the poisoned run's own stream
+    run_dir = tmp_path / "root" / ids[3]
+    events_file = next(
+        f for f in os.listdir(run_dir) if f.endswith(".events.jsonl")
+    )
+    kinds = [
+        json.loads(l)["kind"] for l in open(run_dir / events_file)
+    ]
+    assert kinds.count("run_failed") == 1
+    # survivors bit-identical to a batch without the poisoned tenant
+    clean = RunManager(str(tmp_path / "clean"))
+    clean_ids = {
+        s: clean.submit(_cfg(rounds=4, seed=s)) for s in healthy_seeds
+    }
+    clean.drain()
+    for s in healthy_seeds:
+        a = pickle.load(open(mgr.get(ids[s])["record"], "rb"))
+        b = pickle.load(open(clean.get(clean_ids[s])["record"], "rb"))
+        a.pop("roundsPerSec")
+        b.pop("roundsPerSec")
+        assert pickle.dumps(a) == pickle.dumps(b), f"seed {s} diverged"
+
+
+def test_queue_cap_and_idempotency(tmp_path, synthetic_mnist):
+    from byzantine_aircomp_tpu.serve.runs import QueueFull, RunManager
+
+    mgr = RunManager(str(tmp_path / "root"), queue_cap=2)
+    rid, created = mgr.submit_idempotent(_cfg(seed=1), key="same-key")
+    assert created
+    mgr.submit(_cfg(seed=2))
+    with pytest.raises(QueueFull, match="cap 2"):
+        mgr.submit(_cfg(seed=3))
+    # an idempotent retry of a QUEUED submission is not a new run and
+    # never bounces off the cap
+    rid2, created2 = mgr.submit_idempotent(_cfg(seed=1), key="same-key")
+    assert rid2 == rid and not created2
+    mgr.drain()  # queue drains -> cap clears
+    assert mgr.get(rid)["status"] == "completed"
+    mgr.submit(_cfg(seed=4))  # accepted again
+
+
+def test_http_429_and_idempotency_key(tmp_path, synthetic_mnist):
+    """Backpressure + idempotent submit over the HTTP surface.  Only the
+    exporter is started (no scheduler), so submissions stay queued and
+    the cap logic is exercised deterministically."""
+    from byzantine_aircomp_tpu.serve.server import ExperimentServer
+
+    tiny = dict(
+        dataset="mnist", honest_size=6, byz_size=0, rounds=2,
+        display_interval=2, batch_size=16, agg="mean", eval_train=False,
+    )
+    srv = ExperimentServer(
+        str(tmp_path / "root"), port=0, host="127.0.0.1", queue_cap=2
+    )
+    srv.exporter.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        s1, r1 = _req(base, "POST", "/runs",
+                      {**tiny, "seed": 1, "idempotency_key": "k-1"})
+        assert s1 == 201
+        # client retry with the same key: 200, same run, no new slot
+        s1b, r1b = _req(base, "POST", "/runs",
+                        {**tiny, "seed": 1, "idempotency_key": "k-1"})
+        assert s1b == 200 and r1b["run_id"] == r1["run_id"]
+        s2, _ = _req(base, "POST", "/runs", {**tiny, "seed": 2})
+        assert s2 == 201
+        s3, err = _req(base, "POST", "/runs", {**tiny, "seed": 3})
+        assert s3 == 429 and "queue full" in err["error"]
+        s4, _ = _req(base, "POST", "/runs",
+                     {**tiny, "seed": 4, "idempotency_key": 7})
+        assert s4 == 400  # non-string key
+    finally:
+        srv.exporter.close()
+        srv.manager.close()
+
+
+def test_streamed_config_runs_solo(tmp_path, synthetic_mnist):
+    """A streamed-cohort config (cohort_size > 0) — which the batch
+    contract rejects — is accepted and scheduled as a SOLO single-lane
+    group through the harness path (docs/SERVING.md)."""
+    from byzantine_aircomp_tpu.serve.runs import RunManager
+
+    mgr = RunManager(str(tmp_path / "root"))
+    # sharded=False: the 8-device testbed would otherwise auto-shard the
+    # solo run and reject cohort_size=2 on the 8-wide clients axis
+    rid = mgr.submit(
+        _cfg(
+            honest_size=12, byz_size=4, rounds=2, agg="median",
+            attack="gaussian", noise_var=0.1, service="on",
+            population=48, churn_arrival=0.05, churn_departure=0.02,
+            straggler_prob=0.2, cohort_size=2, sharded=False, seed=1,
+        )
+    )
+    assert mgr.get(rid)["solo"] is True
+    mgr.drain()
+    info = mgr.get(rid)
+    assert info["status"] == "completed", info
+    assert info["lowerings"] == 1
+    assert info["val_acc"] is not None
+    assert os.path.exists(info["record"])
+
+
+def test_mesh_tenant_runs_solo(tmp_path, synthetic_mnist):
+    """A population-mesh config (pop_shards > 1) is likewise a solo
+    single-lane group instead of a rejection."""
+    from byzantine_aircomp_tpu.serve.runs import RunManager
+
+    mgr = RunManager(str(tmp_path / "root"))
+    rid = mgr.submit(
+        _cfg(
+            honest_size=12, byz_size=4, rounds=2, agg="median",
+            attack="gaussian", noise_var=0.1, service="on",
+            population=48, churn_arrival=0.05, churn_departure=0.02,
+            straggler_prob=0.2, cohort_size=2, pop_shards=8, seed=1,
+        )
+    )
+    assert mgr.get(rid)["solo"] is True
+    mgr.drain()
+    info = mgr.get(rid)
+    assert info["status"] == "completed", info
+    assert os.path.exists(info["record"])
+
+
+def test_server_resume_bit_identity_through_checkpoints(
+    tmp_path, synthetic_mnist
+):
+    """Acceptance bar (PR 14): kill the scheduler mid-round (a
+    BaseException, like a real SIGKILL, escapes the group's exception
+    handling), replay the journal in a fresh manager, and the resumed
+    runs' final records are bit-identical to an uninterrupted manager."""
+    from byzantine_aircomp_tpu.serve.batch import BatchRunner
+    from byzantine_aircomp_tpu.serve.runs import RunManager
+
+    root = str(tmp_path / "root")
+    crashed = RunManager(root)
+    ids = [crashed.submit(_cfg(rounds=4, seed=s)) for s in (21, 22)]
+
+    orig_run_round = BatchRunner.run_round
+    armed = [True]
+
+    def dying_run_round(self, round_idx):
+        if armed[0] and round_idx == 2:
+            raise KeyboardInterrupt  # SIGKILL stand-in: not an Exception
+        return orig_run_round(self, round_idx)
+
+    BatchRunner.run_round = dying_run_round
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            crashed.drain()
+    finally:
+        BatchRunner.run_round = orig_run_round
+        armed[0] = False
+    # the manager object is abandoned exactly as a dead process would be
+
+    healed = RunManager(root)
+    requeued = healed.recover()
+    assert sorted(requeued) == sorted(ids)
+    for rid in ids:
+        # rounds 0 and 1 were durably checkpointed before the kill
+        assert healed.get(rid)["resume_round"] == 2
+    healed.drain()
+    for rid in ids:
+        info = healed.get(rid)
+        assert info["status"] == "completed", info
+        assert info["lowerings"] == 1  # the resumed group lowered once
+
+    control = RunManager(str(tmp_path / "control"))
+    control_ids = [control.submit(_cfg(rounds=4, seed=s)) for s in (21, 22)]
+    control.drain()
+    for rid, crid in zip(ids, control_ids):
+        a = pickle.load(open(healed.get(rid)["record"], "rb"))
+        b = pickle.load(open(control.get(crid)["record"], "rb"))
+        a.pop("roundsPerSec")
+        b.pop("roundsPerSec")
+        assert pickle.dumps(a) == pickle.dumps(b)
+    # the journal-replay adoption is in the run's own audit stream
+    run_dir = tmp_path / "root" / ids[0]
+    events_file = next(
+        f for f in os.listdir(run_dir) if f.endswith(".events.jsonl")
+    )
+    events = [json.loads(l) for l in open(run_dir / events_file)]
+    replays = [e for e in events if e["kind"] == "journal_replay"]
+    assert len(replays) == 1 and replays[0]["round"] == 2
+    assert replays[0]["status"] == "resumed"
+    # seq stays monotonic across the reopen (one total order per stream)
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+
+
 # ------------------------------------------------- metrics tenancy
 
 
