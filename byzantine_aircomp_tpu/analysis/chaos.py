@@ -28,6 +28,24 @@ failure scenarios and asserts the recovery invariants:
   ``lowerings == 1`` and records bit-identical to an unkilled baseline,
   and the poisoned run failed as quarantined — not fatally.
 
+The 2-tier scenarios drive a REAL aggregation root (serve/root.py) plus
+edge subprocesses (serve/edge.py) — N+1 processes on one machine:
+
+* ``edge_kill``   — 4 edges, one SIGKILLed mid-round; the root
+  quarantines it on deadline, survivors re-run the round degraded and
+  finish every round; a fresh no-kill topology is bit-identical to the
+  flat single-process aggregate for every aggregator and the packed
+  sign vote; each process lowers its round program exactly once per
+  degraded-ness and the root never recompiles a fold signature.
+* ``edge_replay`` — zero-trust checks over raw HTTP: a captured
+  submission replayed byte-for-byte is rejected (409), journaled, and
+  quarantines the replayed edge; a forged MAC never reaches the fold
+  and can NOT evict the claimed edge; the quarantine survives a root
+  restart via the root journal.
+* ``edge_ledger`` — the bandwidth claim: at d=7850 with the one-bit
+  sign channel, root ingress per round is <= 1/24 of the flat f32
+  submission volume; writes a perf row for ``perf_gate --append``.
+
 Usage::
 
     python -m byzantine_aircomp_tpu.analysis.chaos --scenario smoke
@@ -368,8 +386,448 @@ def scenario_smoke(workdir: str) -> None:
     print("smoke: OK (recovered, quarantined, bit-identical)")
 
 
+# ----------------------------------------------- 2-tier edge topology
+
+_EDGE_DEADLINE = 1200.0  # N+1 jax processes time-slicing one CI core
+
+
+def _topology(workdir: str, **over) -> str:
+    """Write a topology JSON with fresh random per-edge HMAC keys."""
+    cfg: Dict[str, Any] = {
+        "edges": 4, "k": 32, "d": 64, "cohort": 4, "rounds": 3,
+        "aggs": ["median", "trimmed_mean", "mean", "gm2"],
+        "sign_bits": 1, "gm2_maxiter": 40, "seed": 7,
+        "partial_timeout": 90.0,
+    }
+    cfg.update(over)
+    cfg["keys"] = {
+        str(e): os.urandom(32).hex() for e in range(cfg["edges"])
+    }
+    path = os.path.join(workdir, "topo.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f, indent=1)
+    return path
+
+
+class Root:
+    """One aggregation-root subprocess on an ephemeral port."""
+
+    def __init__(self, topo: str, obs_dir: str, log_path: str,
+                 linger: float = 3.0):
+        self.log_path = log_path
+        self._log_fh = open(log_path, "ab")
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "byzantine_aircomp_tpu", "root",
+                "--config", topo, "--host", "127.0.0.1", "--port", "0",
+                "--obs-dir", obs_dir, "--linger", str(linger),
+            ],
+            stdout=self._log_fh,
+            stderr=subprocess.STDOUT,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        self.port = self._await_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+
+    def _await_port(self) -> int:
+        deadline = time.time() + _BOOT_DEADLINE
+        marker = "edge root on 127.0.0.1:"
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                raise AssertionError(
+                    f"root exited rc={self.proc.returncode} before "
+                    f"binding; see {self.log_path}"
+                )
+            try:
+                with open(self.log_path) as f:
+                    for line in f:
+                        if marker in line:
+                            return int(line.split(marker, 1)[1].split()[0])
+            except OSError:
+                pass
+            time.sleep(0.2)
+        raise AssertionError(f"root never bound a port; see {self.log_path}")
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None) -> tuple:
+        """(status, parsed-JSON) — 4xx/5xx return, they don't raise."""
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self.url}{path}", data=data, method=method
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            return exc.code, json.loads(exc.read().decode())
+
+    def metrics_text(self) -> str:
+        with urllib.request.urlopen(
+            f"{self.url}/metrics", timeout=30
+        ) as resp:
+            return resp.read().decode()
+
+    def wait_round(self, rnd: int,
+                   deadline: float = _EDGE_DEADLINE) -> None:
+        end = time.time() + deadline
+        while time.time() < end:
+            status, info = self.request("GET", f"/rounds/{rnd}")
+            if status == 200 and info.get("completed"):
+                return
+            time.sleep(0.2)
+        raise AssertionError(f"round {rnd} never completed")
+
+    def wait_exit(self, deadline: float = _EDGE_DEADLINE) -> dict:
+        """Wait for the root's natural exit; parse the results line."""
+        self.proc.wait(timeout=deadline)
+        self._log_fh.close()
+        marker = "edge root results: "
+        with open(self.log_path) as f:
+            for line in f:
+                if marker in line:
+                    return json.loads(line.split(marker, 1)[1])
+        raise AssertionError(f"no results line in {self.log_path}")
+
+    def close(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=30)
+        if not self._log_fh.closed:
+            self._log_fh.close()
+
+
+class EdgeProc:
+    """One edge subprocess bound to a shard of the topology."""
+
+    def __init__(self, topo: str, shard: int, root_url: str,
+                 obs_dir: str, log_path: str):
+        self.shard = shard
+        self.log_path = log_path
+        self._log_fh = open(log_path, "ab")
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "byzantine_aircomp_tpu", "edge",
+                "--config", topo, "--shard", str(shard),
+                "--root-url", root_url, "--obs-dir", obs_dir,
+            ],
+            stdout=self._log_fh,
+            stderr=subprocess.STDOUT,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+
+    def kill9(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=30)
+        self._log_fh.close()
+
+    def summary(self, deadline: float = _EDGE_DEADLINE) -> dict:
+        self.proc.wait(timeout=deadline)
+        if not self._log_fh.closed:
+            self._log_fh.close()
+        marker = f"edge {self.shard}: {{"
+        with open(self.log_path) as f:
+            for line in f:
+                if marker in line:
+                    return json.loads(line.split(":", 1)[1])
+        raise AssertionError(
+            f"edge {self.shard} printed no summary; see {self.log_path}"
+        )
+
+    def close(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+        if not self._log_fh.closed:
+            self._log_fh.close()
+
+
+def _flat_reference(cfg) -> Dict[int, Dict[str, Any]]:
+    """The flat single-process aggregate per round: ``SeqShardCtx`` over
+    the same shard partition plus the whole-stack packed sign vote —
+    exactly what tree == sequential promises to match bit-for-bit."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import aggregators, shardctx
+    from ..serve.edge import round_stack
+
+    out: Dict[int, Dict[str, Any]] = {}
+    for rnd in range(cfg.rounds):
+        stack = round_stack(cfg.seed, rnd, cfg.k, cfg.d)
+        ctx = shardctx.SeqShardCtx(cfg.edges)
+
+        def rebuild(c):
+            return jax.lax.dynamic_slice(
+                stack, (c * cfg.cohort, 0), (cfg.cohort, cfg.d)
+            )
+
+        ref: Dict[str, Any] = {}
+        if cfg.aggs:
+            sa, sf, nf = aggregators.stream_stats(
+                rebuild, cfg.n_chunks, cfg.d, ctx
+            )
+            for name in cfg.aggs:
+                ref[name] = np.asarray(aggregators.stream_aggregate(
+                    name, rebuild,
+                    k=cfg.k, d=cfg.d, n_chunks=cfg.n_chunks,
+                    degraded=False, sum_all=sa, sum_finite=sf,
+                    n_finite=nf, quantile=cfg.quantile,
+                    sketch_bins=cfg.sketch_bins,
+                    trim_ratio=cfg.trim_ratio, maxiter=cfg.gm2_maxiter,
+                    ctx=ctx,
+                ))
+        if cfg.sign_bits == 1:
+            words, kv = aggregators.pack_signs(
+                stack, jnp.zeros(cfg.d, jnp.float32)
+            )
+            ref["signvote"] = np.asarray(
+                (2 * aggregators.packed_sign_votes(words, cfg.d) - kv)
+                .astype(jnp.int32)
+            )
+        out[rnd] = ref
+    return out
+
+
+def _assert_matches_flat(cfg, results: dict, ref: dict) -> None:
+    from ..ops import shardctx
+
+    for rnd in range(cfg.rounds):
+        rr = results["rounds"][str(rnd)]
+        assert rr["completed"] and not rr["degraded"], (rnd, rr)
+        for name in cfg.result_names:
+            got = shardctx.decode_leaf(rr["results"][name])
+            assert got.tobytes() == ref[rnd][name].tobytes(), (
+                f"round {rnd} {name}: tree result differs from the flat "
+                f"single-process aggregate"
+            )
+        print(f"  round {rnd}: tree == flat bit-identical "
+              f"({', '.join(cfg.result_names)})")
+
+
+def scenario_edge_kill(workdir: str) -> None:
+    from ..serve.edge import TopologyConfig
+
+    topo = _topology(workdir)
+    cfg = TopologyConfig.load(topo)
+    obs = os.path.join(workdir, "obs")
+    root = Root(topo, obs, os.path.join(workdir, "root.log"))
+    edges = [
+        EdgeProc(topo, e, root.url, obs,
+                 os.path.join(workdir, f"edge{e}.log"))
+        for e in range(cfg.edges)
+    ]
+    try:
+        # let round 0 close healthy (every edge warm + compiled), then
+        # SIGKILL edge 2 — it lands mid-round-1, every later phase of
+        # which needs all four edges, so only the deadline can clear it
+        root.wait_round(0)
+        edges[2].kill9()
+        print("killed -9 edge 2 after round 0; survivors must finish "
+              "degraded")
+        results = root.wait_exit()
+        for e in (0, 1, 3):
+            s = edges[e].summary()
+            assert s["status"] == "completed", s
+            assert s["rounds"] == cfg.rounds, s
+            assert s["steady_state_ok"], s
+            assert s["lowerings"] == {
+                "edge_round_fn": 1, "edge_round_fn_degraded": 1,
+            }, f"edge {e} lowered more than once per program: {s}"
+        assert edges[2].proc.returncode == -signal.SIGKILL
+    finally:
+        for e in edges:
+            e.close()
+        root.close()
+    assert results["quarantined"] == {"2": "partial_timeout"}, results
+    assert results["fold_lowerings"] == results["fold_signatures"], (
+        f"root recompiled a fold mid-run: {results['fold_lowerings']} "
+        f"lowerings vs {results['fold_signatures']} signatures"
+    )
+    assert results["rounds"]["0"]["completed"]
+    assert not results["rounds"]["0"]["degraded"]
+    for rnd in range(1, cfg.rounds):
+        rr = results["rounds"][str(rnd)]
+        assert rr["completed"] and rr["degraded"], (rnd, rr)
+    print("degraded rounds completed over the 3 survivors; now a fresh "
+          "no-kill topology vs the flat single-process aggregate")
+    obs2 = os.path.join(workdir, "obs_base")
+    root2 = Root(topo, obs2, os.path.join(workdir, "root_base.log"))
+    edges2 = [
+        EdgeProc(topo, e, root2.url, obs2,
+                 os.path.join(workdir, f"edge_base{e}.log"))
+        for e in range(cfg.edges)
+    ]
+    try:
+        base = root2.wait_exit()
+        for e in edges2:
+            s = e.summary()
+            assert s["status"] == "completed" and s["steady_state_ok"], s
+    finally:
+        for e in edges2:
+            e.close()
+        root2.close()
+    assert not base["quarantined"], base
+    assert base["fold_lowerings"] == base["fold_signatures"], base
+    ref = _flat_reference(cfg)
+    _assert_matches_flat(cfg, base, ref)
+    # round 0 of the killed run closed healthy before the kill: it too
+    # must match the flat aggregate bit-for-bit
+    from ..ops import shardctx
+    for name in cfg.result_names:
+        got = shardctx.decode_leaf(results["rounds"]["0"]["results"][name])
+        assert got.tobytes() == ref[0][name].tobytes(), name
+    print("edge_kill: OK (degraded survival + bit-identical no-kill run)")
+
+
+def scenario_edge_replay(workdir: str) -> None:
+    import numpy as np
+
+    from ..ops import shardctx
+    from ..serve import edge as edge_mod
+    from ..serve import journal as journal_lib
+    from ..utils.io import iter_jsonl
+
+    topo = _topology(
+        workdir, edges=2, k=8, d=16, cohort=4, rounds=1, aggs=[],
+        partial_timeout=600.0,
+    )
+    cfg = edge_mod.TopologyConfig.load(topo)
+    obs = os.path.join(workdir, "obs")
+
+    def envelope(edge: int, nonce: int, key: str = None,
+                 mac: str = None) -> dict:
+        counts = np.zeros(cfg.d, np.int32)
+        kv = np.asarray(cfg.rows_per_edge, np.int32)
+        body = {
+            "op": "partial", "round": 0, "epoch": 0, "seq": 0,
+            "meta": {"label": "signvote"},
+            **shardctx.partial_to_wire([counts, kv], ("sum", "sum")),
+            "edge": edge, "nonce": nonce,
+        }
+        body["mac"] = mac or edge_mod.sign_envelope(
+            key or cfg.keys[edge], body
+        )
+        return body
+
+    root = Root(topo, obs, os.path.join(workdir, "root.log"))
+    try:
+        st, resp = root.request("POST", "/partials", envelope(1, 1))
+        assert st == 200, (st, resp)
+        # byte-for-byte replay of a captured, correctly signed edge-0
+        # submission: the mac verifies, the nonce does not — rejected,
+        # journaled, and the compromised channel is contained
+        captured = envelope(0, 1)
+        st, resp = root.request("POST", "/partials", captured)
+        assert st == 200, (st, resp)
+        st, resp = root.request("POST", "/partials", captured)
+        assert st == 409 and resp["error"] == "replay", (st, resp)
+        st, resp = root.request("POST", "/partials", envelope(0, 2))
+        assert st == 410 and resp["error"] == "replayed_nonce", (st, resp)
+        # a forged mac is rejected before any state changes, and can NOT
+        # quarantine the edge whose identity it claims
+        st, resp = root.request(
+            "POST", "/partials", envelope(1, 99, mac="00" * 32)
+        )
+        assert st == 401 and resp["error"] == "bad_mac", (st, resp)
+        st, resp = root.request(
+            "POST", "/partials", envelope(7, 1, key="11" * 32)
+        )
+        assert st == 401 and resp["error"] == "unknown edge", (st, resp)
+        st, res = root.request("GET", "/results")
+        assert st == 200
+        assert res["quarantined"] == {"0": "replayed_nonce"}, res
+        assert res["live"] == [1], res
+        text = root.metrics_text()
+        for needle in (
+            "aircomp_edge_quarantines_total 1",
+            'aircomp_edge_quarantine_reasons_total'
+            '{reason="replayed_nonce"} 1',
+            'aircomp_edge_rejects_total{reason="replay"} 1',
+            'aircomp_edge_rejects_total{reason="bad_mac"} 1',
+        ):
+            assert needle in text, f"{needle!r} missing from /metrics"
+    finally:
+        root.close()
+    journal = os.path.join(obs, journal_lib.ROOT_JOURNAL_NAME)
+    ops = [r.get("op") for r in iter_jsonl(journal)]
+    for op in ("replay_rejected", "forged_rejected", "edge_quarantined"):
+        assert op in ops, f"{op} not journaled: {ops}"
+    # the containment survives a root restart: the journal replays the
+    # quarantine before the socket opens, so a fresh, validly signed
+    # submission from the replayed edge is still refused
+    root2 = Root(topo, obs, os.path.join(workdir, "root2.log"))
+    try:
+        st, resp = root2.request("POST", "/partials", envelope(0, 3))
+        assert st == 410 and resp["error"] == "replayed_nonce", (st, resp)
+    finally:
+        root2.close()
+    print("edge_replay: OK (replay 409+quarantined, forgery contained, "
+          "journal survives restart)")
+
+
+def scenario_edge_ledger(workdir: str) -> None:
+    from ..serve.edge import TopologyConfig
+
+    topo = _topology(
+        workdir, edges=4, k=128, d=7850, cohort=32, rounds=2, aggs=[],
+        partial_timeout=300.0,
+    )
+    cfg = TopologyConfig.load(topo)
+    obs = os.path.join(workdir, "obs")
+    root = Root(topo, obs, os.path.join(workdir, "root.log"))
+    edges = [
+        EdgeProc(topo, e, root.url, obs,
+                 os.path.join(workdir, f"edge{e}.log"))
+        for e in range(cfg.edges)
+    ]
+    try:
+        results = root.wait_exit()
+        for e in edges:
+            s = e.summary()
+            assert s["status"] == "completed" and s["steady_state_ok"], s
+            assert s["lowerings"] == {"edge_round_fn": 1}, s
+    finally:
+        for e in edges:
+            e.close()
+        root.close()
+    assert not results["quarantined"], results
+    assert results["fold_lowerings"] == results["fold_signatures"], results
+    flat_f32 = cfg.k * cfg.d * 4  # every client shipping f32 coordinates
+    per_round = [
+        results["rounds"][str(r)]["ingress_bytes"]
+        for r in range(cfg.rounds)
+    ]
+    worst = max(per_round)
+    ratio = flat_f32 / worst
+    assert ratio >= 24.0, (
+        f"root ingress {worst}B/round vs flat f32 {flat_f32}B: only "
+        f"{ratio:.1f}x (need >= 24x)"
+    )
+    row = {
+        "metric": "edge_root_ingress_bytes_per_round_sb1",
+        "value": float(worst), "unit": "bytes/round", "platform": "cpu",
+        "k": cfg.k, "d": cfg.d, "agg": "signmv", "sign_bits": 1,
+        "bytes_moved": worst, "bytes_moved_f32": flat_f32,
+        "note": "analysis/chaos.py edge_ledger "
+                "(4 edges, packed one-bit sign wire)",
+    }
+    row_path = os.path.join(workdir, "edge_ledger_row.json")
+    with open(row_path, "w") as f:
+        json.dump(row, f, indent=1)
+    print(f"edge_ledger: OK (ingress {worst}B/round = flat/{ratio:.1f}, "
+          f"row at {row_path})")
+
+
 SCENARIOS = {
     "kill9": scenario_kill9,
+    "edge_kill": scenario_edge_kill,
+    "edge_replay": scenario_edge_replay,
+    "edge_ledger": scenario_edge_ledger,
     "torn_tail": scenario_torn_tail,
     "kill_midckpt": scenario_kill_midckpt,
     "poisoned": scenario_poisoned,

@@ -612,6 +612,11 @@ def serve_main(argv: Sequence[str]):
                    help="seconds without a completed round before a "
                         "running run counts as wedged (0 = watchdog off); "
                         "/healthz reports 503 while any run is wedged")
+    p.add_argument("--auth-token", type=str, default=None,
+                   help="bearer token required on the mutating endpoints "
+                        "(POST /runs, /cancel, /knobs return 401 without "
+                        "'Authorization: Bearer <token>'); /metrics and "
+                        "/healthz stay open for scrapes")
     args = p.parse_args(list(argv))
     from .serve.server import ExperimentServer
 
@@ -625,6 +630,7 @@ def serve_main(argv: Sequence[str]):
         run_retries=args.run_retries,
         run_backoff=args.run_backoff,
         wedge_secs=args.wedge_secs,
+        auth_token=args.auth_token,
     ).start()
     print(f"experiment server on {args.host}:{server.port} "
           f"(obs root: {args.obs_root})", flush=True)
@@ -644,6 +650,14 @@ def main(argv: Optional[Sequence[str]] = None):
         argv = sys.argv[1:]
     if argv and argv[0] == "serve":
         return serve_main(argv[1:])
+    if argv and argv[0] == "root":
+        from .serve.root import main as root_main
+
+        return root_main(list(argv[1:]))
+    if argv and argv[0] == "edge":
+        from .serve.edge import main as edge_main
+
+        return edge_main(list(argv[1:]))
     args = build_parser().parse_args(argv)
     if (
         args.multihost

@@ -147,6 +147,13 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
     {"name": "nonfinite_loss", "metric": "aircomp_nonfinite_loss_total",
      "window": 8, "reduce": "delta", "op": "ge", "value": 1,
      "severity": "page", "absent": 0.0, "min_samples": 2},
+    # 2-tier containment: ANY edge quarantined inside the window pages —
+    # an evicted edge is lost capacity AND a possible compromise
+    # (replayed nonce, forged payload, result dissent); see RUNBOOK.md
+    {"name": "edge_quarantine_rate",
+     "metric": "aircomp_edge_quarantines_total",
+     "window": 8, "reduce": "delta", "op": "ge", "value": 1,
+     "severity": "page", "absent": 0.0, "min_samples": 2},
 ]
 
 
@@ -354,6 +361,12 @@ def _scenarios() -> Dict[str, Dict[str, List[Dict[str, Any]]]]:
             "breach": start + rounds(2) + rounds(
                 1, start=2, val_loss=float("nan")
             ) + rounds(1, start=3),
+        },
+        "edge_quarantine_rate": {
+            "healthy": healthy_service,
+            "breach": start + rounds(2) + [
+                _mk("edge_quarantine", edge=2, reason="partial_timeout"),
+            ] + rounds(2, start=2),
         },
     }
 

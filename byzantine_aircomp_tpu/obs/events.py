@@ -69,7 +69,15 @@ from typing import Any, Dict, Optional
 # retry), and ``journal_replay`` (a restarted server re-adopted this run
 # from the durable journal — ``status`` says resumed/restarted and
 # ``round`` the checkpoint it resumes from).
-SCHEMA_VERSION = 6
+# v7: added the 2-tier aggregation kinds (serve/root.py): ``edge_partial``
+# (one accepted, HMAC-verified wire partial — ``bytes`` is its raw
+# ingress size, the quantity the perf ledger's bytes/round row sums),
+# ``edge_reject`` (a zero-trust rejection: ``reason`` is bad_mac /
+# replay / the payload-check failures), ``edge_quarantine`` (an edge
+# contained — partial_timeout, replayed_nonce, bad_payload,
+# nonfinite_partial, result_mismatch), and ``edge_round`` (a round
+# closed over the live set; ``degraded`` marks a surviving-edge fold).
+SCHEMA_VERSION = 7
 
 # round-event field -> reference pickled-record key it mirrors
 # (round r's event carries metrics the record stores at index r+1 for the
@@ -144,6 +152,14 @@ _REQUIRED: Dict[str, tuple] = {
     "run_failed": ("run_id", "round", "reason"),
     "run_requeued": ("run_id", "round", "retries", "reason"),
     "journal_replay": ("run_id", "status", "round"),
+    # 2-tier aggregation (serve/root.py): the root's zero-trust audit
+    # trail — accepted partials (with wire bytes for the ingress ledger),
+    # rejections (reason: bad_mac/replay/...), edge containment, and the
+    # per-round fleet close (degraded marks a surviving-edge fold)
+    "edge_partial": ("round", "edge", "seq", "bytes"),
+    "edge_reject": ("edge", "reason"),
+    "edge_quarantine": ("edge", "reason"),
+    "edge_round": ("round", "epoch", "edges", "degraded", "ingress_bytes"),
 }
 
 

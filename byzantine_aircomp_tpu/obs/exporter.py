@@ -15,11 +15,15 @@ ephemeral port (tests); the bound port is on ``.port`` after
 ``start()``.
 
 ``routes`` lets a caller mount extra endpoints on the same port without
-subclassing the handler: a callable ``(method, path, body) ->
-Optional[(status, content_type, body_bytes)]`` tried before the built-in
-``/metrics``/``/healthz`` handling (``None`` falls through).  The
-experiment server (``serve/server.py``) rides this hook so one socket
-serves both the control plane and the scrape surface.
+subclassing the handler: a callable ``(method, raw_path, body, headers)
+-> Optional[(status, content_type, body_bytes)]`` tried before the
+built-in ``/metrics``/``/healthz`` handling (``None`` falls through).
+``raw_path`` keeps the query string (the edge-root fold poll passes
+epoch/edge as query params) and ``headers`` is a plain lower-cased dict
+(the experiment server's bearer-token check reads ``authorization``).
+The experiment server (``serve/server.py``) and the aggregation root
+(``serve/root.py``) ride this hook so one socket serves both a control
+plane and the scrape surface.
 """
 
 from __future__ import annotations
@@ -33,9 +37,9 @@ from .metrics import MetricsRegistry
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
-#: extra-route hook: (method, path, body) -> (status, content_type, body)
-#: or None to fall through to the built-in routes
-RouteFn = Callable[[str, str, bytes], Optional[tuple]]
+#: extra-route hook: (method, raw_path_with_query, body, headers) ->
+#: (status, content_type, body) or None to fall through to the built-ins
+RouteFn = Callable[[str, str, bytes, Dict[str, str]], Optional[tuple]]
 
 
 class MetricsExporter:
@@ -82,9 +86,10 @@ class MetricsExporter:
                     return False
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
-                hit = exporter._routes(
-                    method, self.path.split("?", 1)[0], body
-                )
+                headers = {
+                    k.lower(): v for k, v in self.headers.items()
+                }
+                hit = exporter._routes(method, self.path, body, headers)
                 if hit is None:
                     return False
                 self._reply(*hit)

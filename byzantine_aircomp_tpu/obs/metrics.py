@@ -424,6 +424,88 @@ class MetricsSink(EventSink):
                 severity=str(e.get("severity", "?")),
             )
 
+    # crash-safe serving (serve/runs.py events, previously journal-only):
+    # terminal failures by machine-readable cause, watchdog requeues, and
+    # journal re-adoptions — the counters operators alert on without
+    # tailing the journal
+
+    @staticmethod
+    def _failure_cause(reason: str) -> str:
+        if reason.startswith("quarantined"):
+            return "quarantine"
+        if "wedged" in reason:
+            return "wedged"
+        return "error"
+
+    def _on_run_failed(self, e: Dict[str, Any]) -> None:
+        reg = self.registry
+        reason = str(e.get("reason", ""))
+        cause = self._failure_cause(reason)
+        reg.inc("aircomp_run_failures_total",
+                help_text="terminal run failures, by cause",
+                cause=cause)
+        if cause == "quarantine":
+            reg.inc("aircomp_quarantines_total",
+                    help_text="lane quarantines (run-level containment)")
+
+    def _on_run_requeued(self, e: Dict[str, Any]) -> None:
+        self.registry.inc(
+            "aircomp_requeues_total",
+            help_text="watchdog bounded-backoff requeues",
+        )
+
+    def _on_journal_replay(self, e: Dict[str, Any]) -> None:
+        self.registry.inc(
+            "aircomp_journal_replays_total",
+            help_text="runs re-adopted from the durable journal on boot",
+            status=str(e.get("status", "?")),
+        )
+
+    # 2-tier aggregation (serve/root.py events): the root's zero-trust
+    # counters — ingress volume, rejections by reason, containment, and
+    # degraded-round visibility (obs/alerts.py pages on quarantine rate)
+
+    def _on_edge_partial(self, e: Dict[str, Any]) -> None:
+        reg = self.registry
+        reg.inc("aircomp_edge_partials_total",
+                help_text="accepted HMAC-verified edge partials")
+        if _finite(e.get("bytes")):
+            reg.inc("aircomp_edge_ingress_bytes_total", float(e["bytes"]),
+                    help_text="raw wire bytes accepted by the root")
+
+    def _on_edge_reject(self, e: Dict[str, Any]) -> None:
+        self.registry.inc(
+            "aircomp_edge_rejects_total",
+            help_text="rejected edge submissions, by reason",
+            reason=str(e.get("reason", "?")),
+        )
+
+    def _on_edge_quarantine(self, e: Dict[str, Any]) -> None:
+        reg = self.registry
+        # unlabeled total first: the edge_quarantine_rate alert samples
+        # it directly (registry.value with no labels reads the unlabeled
+        # series), with the per-reason breakdown alongside for operators
+        reg.inc("aircomp_edge_quarantines_total",
+                help_text="edges contained by the root")
+        reg.inc("aircomp_edge_quarantine_reasons_total",
+                help_text="edge quarantines, by reason",
+                reason=str(e.get("reason", "?")))
+
+    def _on_edge_round(self, e: Dict[str, Any]) -> None:
+        reg = self.registry
+        reg.inc("aircomp_edge_rounds_total",
+                help_text="2-tier rounds closed over the live set")
+        if e.get("degraded"):
+            reg.inc("aircomp_edge_degraded_rounds_total",
+                    help_text="rounds folded over a surviving edge subset")
+        if _finite(e.get("edges")):
+            reg.set("aircomp_edge_live", float(e["edges"]),
+                    help_text="live (non-quarantined) edges")
+        if _finite(e.get("ingress_bytes")):
+            reg.set("aircomp_edge_round_ingress_bytes",
+                    float(e["ingress_bytes"]),
+                    help_text="root ingress bytes for the last closed round")
+
     # health -------------------------------------------------------------
 
     #: seconds without a completed round before a "running" run reports

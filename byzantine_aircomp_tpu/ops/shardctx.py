@@ -36,8 +36,11 @@ carry slots cost nothing, exactly like the trainer's donated carry.
 
 from __future__ import annotations
 
+import base64
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def fold_leaves(parts, tag, n_shards: int):
@@ -154,3 +157,133 @@ class SeqShardCtx:
 #: module-level singleton: the default context every streamed aggregator
 #: and the trainer's observation pass use when pop-sharding is off
 LOCAL = LocalShardCtx()
+
+
+# --------------------------------------------------------------------------
+# Serializable cross-process partials (the 2-tier edge -> root wire)
+#
+# The engines above merge partial carries INSIDE one process.  The tree
+# topology (serve/edge.py computes a shard's partial, serve/root.py folds
+# the S shards' submissions) needs the same algebra to survive a trip
+# through JSON: a canonical, schema-versioned encoding of one shard's flat
+# partial leaves plus their spec tags.  Design points:
+#
+# * canonical bytes — every leaf serializes as the raw C-order bytes of a
+#   deterministic wire dtype, base64'd into JSON.  Two processes holding
+#   bit-identical arrays produce byte-identical wire strings, which is
+#   what lets the root HMAC-verify submissions and byte-compare result
+#   consensus ("same"-style folds) without ever re-deriving floats.
+# * lossless narrow downcast — integer leaves (rank counts, histograms,
+#   finite counts, sign-vote plane sums) are bounded by rows-per-shard,
+#   so they ship as the smallest integer dtype whose range holds their
+#   actual values and are widened back to the logical dtype on decode.
+#   This is the 4x on top of the packed sign channel's 32x that keeps
+#   root ingress at a small fraction of the flat f32 wire.
+# * float leaves ship verbatim — the left fold is association-sensitive;
+#   the wire must not round.
+#
+# ``WIRE_VERSION`` bumps on any change to this layout so a mixed-version
+# fleet fails loudly at decode instead of folding garbage.
+# --------------------------------------------------------------------------
+
+#: version stamp carried by every wire partial (checked on decode)
+WIRE_VERSION = 1
+
+#: narrowing ladder for integer leaves, smallest first
+_NARROW_INTS = (
+    np.uint8, np.int8, np.uint16, np.int16, np.uint32, np.int32,
+    np.uint64, np.int64,
+)
+
+
+def flat_tags(spec, flat_leaves):
+    """Spec tags aligned with a flattened partial: specs are declared
+    per-leaf (matching pytrees), but a single-string spec legitimately
+    covers a multi-leaf carry whose leaves all merge the same way."""
+    tags = [t for t in jax.tree.leaves(spec) if not _is_empty(t)]
+    if len(tags) == 1 and len(flat_leaves) > 1:
+        tags = tags * len(flat_leaves)
+    if len(tags) != len(flat_leaves):
+        raise ValueError(
+            f"spec has {len(tags)} tags for {len(flat_leaves)} leaves"
+        )
+    return tags
+
+
+def encode_leaf(x) -> dict:
+    """One array -> a canonical JSON-safe dict (dtype, wire dtype, shape,
+    base64 C-order bytes).  Integer/bool leaves narrow losslessly."""
+    a = np.asarray(x)
+    logical = a.dtype
+    wire = a
+    if a.dtype.kind == "b":
+        wire = a.astype(np.uint8)
+    elif a.dtype.kind in "iu" and a.size:
+        lo = int(a.min())
+        hi = int(a.max())
+        for cand in _NARROW_INTS:
+            info = np.iinfo(cand)
+            if lo >= info.min and hi <= info.max:
+                if np.dtype(cand).itemsize < logical.itemsize:
+                    wire = a.astype(cand)
+                break
+    return {
+        "dtype": logical.str,
+        "wdtype": np.asarray(wire).dtype.str,
+        "shape": list(a.shape),
+        "data": base64.b64encode(
+            np.ascontiguousarray(wire).tobytes()
+        ).decode("ascii"),
+    }
+
+
+def decode_leaf(obj: dict) -> np.ndarray:
+    """Inverse of :func:`encode_leaf`: back to the logical dtype,
+    bit-exact."""
+    wire_dt = np.dtype(obj["wdtype"])
+    raw = base64.b64decode(obj["data"])
+    flat = np.frombuffer(raw, dtype=wire_dt)
+    arr = flat.reshape(tuple(obj["shape"]))
+    logical = np.dtype(obj["dtype"])
+    if logical.kind == "b":
+        return arr.astype(bool)
+    if wire_dt != logical:
+        return arr.astype(logical)
+    return np.array(arr)  # own the buffer (frombuffer views are read-only)
+
+
+def partial_to_wire(flat_leaves, tags) -> dict:
+    """Flat partial leaves + aligned tags -> one canonical wire dict."""
+    leaves = [encode_leaf(x) for x in flat_leaves]
+    return {
+        "wire": WIRE_VERSION,
+        "tags": list(tags),
+        "leaves": leaves,
+    }
+
+
+def partial_from_wire(obj: dict):
+    """Wire dict -> ``(flat numpy leaves, tags)``; raises ``ValueError``
+    on version skew or malformed payloads (the root maps decode failures
+    to edge quarantine — garbage must never reach the fold)."""
+    if not isinstance(obj, dict) or obj.get("wire") != WIRE_VERSION:
+        raise ValueError(
+            f"wire version {obj.get('wire') if isinstance(obj, dict) else obj!r}"
+            f" != {WIRE_VERSION}"
+        )
+    tags = list(obj.get("tags") or ())
+    raw = obj.get("leaves")
+    if not isinstance(raw, list) or len(raw) != len(tags):
+        raise ValueError("wire partial: leaves/tags arity mismatch")
+    return [decode_leaf(e) for e in raw], tags
+
+
+def fold_partials(stacked_leaves, tags, n_shards: int):
+    """Fold per-leaf stacked [S, ...] partials under their tags with the
+    canonical left fold — the root's merge, identical by construction to
+    :class:`SeqShardCtx`'s (same :func:`fold_leaves`, same shard order).
+    Works on numpy or jax arrays; traced under jit by the root so its
+    lowerings are retrace-gated like every other hot-path program."""
+    return tuple(
+        fold_leaves(s, t, n_shards) for s, t in zip(stacked_leaves, tags)
+    )

@@ -1,0 +1,571 @@
+"""Edge aggregator: one shard of the 2-tier edge -> root topology.
+
+The paper's fusion center becomes a tree: N edge processes each own a
+contiguous range of cohort chunks (exactly a ``pop_shards`` shard), run
+the UNCHANGED streamed aggregator programs from ``ops/aggregators.py``,
+and merge through :class:`EdgeShardCtx` — a population-shard context
+whose merge points are ordered host callbacks that POST the partial to
+the root and return the fold.  Because the traced per-shard compute is
+the same code the sequential engine runs and the root folds with the
+same ``ops/shardctx.fold_leaves`` in shard order, tree == sequential ==
+mesh stays BIT-identical — no re-derivation, no tolerance windows.
+
+Mechanics worth knowing:
+
+* one round fn per process — the whole round (stats pass, 32-step rank
+  bisection, trimmed tail, Weiszfeld loop, packed sign vote, result
+  consensus) is ONE jitted function; ``jax.experimental.io_callback``
+  (ordered) carries each merge across the network from inside
+  ``fori_loop``/``while_loop`` bodies.  The RetraceDetector wraps it, so
+  an edge that silently re-lowers mid-run fails its exit audit exactly
+  like the trainer would.  A degraded round (surviving edges after a
+  kill) is a legitimately different program and lowers once more.
+* phases are anonymous — every edge executes the same deterministic
+  exchange sequence (all branching depends on merged values, which are
+  bit-identical across edges), so a per-round ``seq`` counter is the
+  whole phase-coordination protocol.
+* zero-trust submissions — every POST carries the edge id, a strictly
+  increasing nonce, and an HMAC-SHA256 over the canonical JSON of the
+  envelope under the edge's pre-shared key.  The root rejects forgeries
+  and replays without folding them (serve/root.py).
+* epoch restarts — when the root quarantines a dead edge mid-round it
+  bumps the round's epoch; survivors see ``stale_epoch``, re-query the
+  live set, and re-run the round in degraded mode (the effective-K
+  guards inside the streamed aggregators take it from there).
+
+``python -m byzantine_aircomp_tpu edge --config topo.json --shard 2
+--root-url http://host:port`` runs one edge to completion; the chaos
+harness (analysis/chaos.py) drives 4 of them plus a root on one machine
+and kills one mid-round.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import hashlib
+import hmac
+import json
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: marker substrings for exceptions that must cross the XLA callback
+#: boundary (io_callback wraps host exceptions in XlaRuntimeError; the
+#: message survives, the type does not)
+RESTART_MARKER = "EDGE_RESTART_EPOCH"
+DEAD_MARKER = "EDGE_QUARANTINED"
+
+
+class RoundRestart(RuntimeError):
+    """The root bumped the round's epoch (an edge died mid-round)."""
+
+    def __init__(self, epoch: int) -> None:
+        super().__init__(f"{RESTART_MARKER}:{epoch}")
+        self.epoch = epoch
+
+
+class EdgeQuarantined(RuntimeError):
+    """The root quarantined THIS edge; the process must stand down."""
+
+    def __init__(self, reason: str = "") -> None:
+        super().__init__(f"{DEAD_MARKER}:{reason}")
+
+
+# --------------------------------------------------------------------------
+# topology config + submission signing (shared with serve/root.py and the
+# chaos harness, which crafts replayed/forged submissions from the same
+# helpers to prove the root rejects them)
+# --------------------------------------------------------------------------
+
+
+def canonical_bytes(obj: Any) -> bytes:
+    """Deterministic JSON bytes — the HMAC input.  ``sort_keys`` plus
+    tight separators means both ends serialize the envelope identically."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+def sign_envelope(key_hex: str, body: Dict[str, Any]) -> str:
+    """HMAC-SHA256 over the canonical envelope (sans ``mac``), hex."""
+    payload = {k: v for k, v in body.items() if k != "mac"}
+    return hmac.new(
+        bytes.fromhex(key_hex), canonical_bytes(payload), hashlib.sha256
+    ).hexdigest()
+
+
+@dataclass
+class TopologyConfig:
+    """The 2-tier run description both tiers load from one JSON file."""
+
+    edges: int
+    k: int
+    d: int
+    cohort: int
+    rounds: int
+    aggs: List[str] = field(default_factory=list)
+    sign_bits: int = 0
+    trim_ratio: float = 0.1
+    quantile: str = "exact"
+    sketch_bins: int = 512
+    gm2_maxiter: int = 1000
+    seed: int = 2021
+    partial_timeout: float = 5.0
+    strike_limit: int = 3
+    keys: Dict[int, str] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.k % self.cohort:
+            raise ValueError(f"k {self.k} % cohort {self.cohort} != 0")
+        if self.n_chunks % self.edges:
+            raise ValueError(
+                f"n_chunks {self.n_chunks} % edges {self.edges} != 0"
+            )
+        missing = [e for e in range(self.edges) if e not in self.keys]
+        if missing:
+            raise ValueError(f"no HMAC key for edges {missing}")
+
+    @property
+    def n_chunks(self) -> int:
+        return self.k // self.cohort
+
+    @property
+    def chunks_per_edge(self) -> int:
+        return self.n_chunks // self.edges
+
+    @property
+    def rows_per_edge(self) -> int:
+        return self.chunks_per_edge * self.cohort
+
+    @property
+    def result_names(self) -> List[str]:
+        names = list(self.aggs)
+        if self.sign_bits == 1:
+            names.append("signvote")
+        return names
+
+    @classmethod
+    def load(cls, path: str) -> "TopologyConfig":
+        with open(path) as f:
+            raw = json.load(f)
+        raw["keys"] = {int(e): k for e, k in raw.get("keys", {}).items()}
+        return cls(**raw)
+
+
+def round_stack(seed: int, rnd: int, k: int, d: int):
+    """The round's deterministic [k, d] client stack.  Every edge (and
+    the flat reference the chaos harness compares against) rebuilds the
+    SAME stack from (seed, round), so a partial disagreement can only
+    come from the aggregation path — which is the thing under test."""
+    import jax
+
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), rnd)
+    return jax.random.normal(key, (k, d), dtype="float32")
+
+
+# --------------------------------------------------------------------------
+# the edge-side shard context
+# --------------------------------------------------------------------------
+
+
+class EdgeShardCtx:
+    """Shard ``p`` of S whose merges cross the network.
+
+    ``scan_idx_merge`` runs this shard's chunk scan exactly the way
+    ``SeqShardCtx.one_shard`` does (same body, same global chunk index
+    range ``[p*cpp, (p+1)*cpp)``), then ships the partial carry through
+    ``exchange(tags, arrays, meta) -> merged arrays`` — an ORDERED
+    ``io_callback``, so exchanges execute in program order even from
+    inside ``fori_loop``/``while_loop`` bodies, which is what keeps the
+    per-round ``seq`` counter aligned across edges."""
+
+    def __init__(self, shard: int, n_shards: int, exchange) -> None:
+        if not 0 <= shard < n_shards:
+            raise ValueError(f"shard {shard} outside [0, {n_shards})")
+        self.shard = shard
+        self.n_shards = n_shards
+        self.exchange = exchange
+
+    def varying(self, x):
+        return x
+
+    def merge(self, carry, spec, meta: Optional[dict] = None):
+        """Merge one partial pytree with the fleet via the root."""
+        import jax
+        from jax.experimental import io_callback
+
+        from ..ops import shardctx
+
+        flat, treedef = jax.tree.flatten(carry)
+        tags = tuple(shardctx.flat_tags(spec, flat))
+        shapes = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in flat]
+        merged = io_callback(
+            functools.partial(self._host_exchange, tags, meta),
+            shapes,
+            *flat,
+            ordered=True,
+        )
+        return jax.tree.unflatten(treedef, merged)
+
+    def _host_exchange(self, tags, meta, *arrays):
+        out = self.exchange(tags, [np.asarray(a) for a in arrays], meta)
+        # NOT ascontiguousarray: that helper promotes 0-d to 1-d, and the
+        # callback contract is exact-shape (scalars like gm2's denominator
+        # and the finite ballot count are legitimate 0-d leaves)
+        return [np.asarray(x, order="C") for x in out]
+
+    def scan_idx_merge(self, n_chunks: int, body, init, spec):
+        import jax
+        import jax.numpy as jnp
+
+        S = self.n_shards
+        if n_chunks % S:
+            raise ValueError(
+                f"n_chunks {n_chunks} not divisible by edges {S}"
+            )
+        cpp = n_chunks // S
+        idxs = self.shard * cpp + jnp.arange(cpp, dtype=jnp.int32)
+
+        def step(carry, c_idx):
+            return body(carry, c_idx), None
+
+        carry, _ = jax.lax.scan(step, init, idxs)
+        return self.merge(carry, spec)
+
+    def scan_merge(self, rebuild, n_chunks: int, body, init, spec):
+        return self.scan_idx_merge(
+            n_chunks, lambda carry, c: body(carry, rebuild(c), c), init, spec
+        )
+
+
+# --------------------------------------------------------------------------
+# the round program
+# --------------------------------------------------------------------------
+
+
+class EdgeCompute:
+    """Builds and caches the edge's jitted round functions.
+
+    One function per degraded-ness: the healthy program and the
+    surviving-set program differ (degraded aggregation switches to the
+    finite/effective-K formulas), so each lowers once and the retrace
+    audit allows exactly those."""
+
+    def __init__(self, cfg: TopologyConfig, shard: int, exchange,
+                 detector=None) -> None:
+        from ..obs import RetraceDetector
+
+        self.cfg = cfg
+        self.shard = shard
+        self.ctx = EdgeShardCtx(shard, cfg.edges, exchange)
+        self.detector = detector if detector is not None else RetraceDetector()
+        self._fns: Dict[bool, Any] = {}
+
+    def fn_name(self, degraded: bool) -> str:
+        return "edge_round_fn_degraded" if degraded else "edge_round_fn"
+
+    def round_fn(self, degraded: bool):
+        import jax
+
+        if degraded not in self._fns:
+            self._fns[degraded] = jax.jit(
+                self.detector.wrap(
+                    self.fn_name(degraded),
+                    functools.partial(self._round, degraded),
+                )
+            )
+        return self._fns[degraded]
+
+    def _round(self, degraded: bool, stack):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import aggregators
+
+        cfg = self.cfg
+        d, cohort, n_chunks = cfg.d, cfg.cohort, cfg.n_chunks
+        ctx = self.ctx
+
+        def rebuild(c):
+            return jax.lax.dynamic_slice(
+                stack, (c * cohort, 0), (cohort, d)
+            )
+
+        outs: Dict[str, Any] = {}
+        sum_all = sum_fin = n_fin = None
+        if cfg.aggs:
+            # one shared stats pass: mean's sums, gm2's init guess, and
+            # the degraded paths' finite-row count, all from one exchange
+            sum_all, sum_fin, n_fin = aggregators.stream_stats(
+                rebuild, n_chunks, d, ctx
+            )
+        for name in cfg.aggs:
+            outs[name] = aggregators.stream_aggregate(
+                name, rebuild,
+                k=cfg.k, d=d, n_chunks=n_chunks, degraded=degraded,
+                sum_all=sum_all, sum_finite=sum_fin, n_finite=n_fin,
+                quantile=cfg.quantile, sketch_bins=cfg.sketch_bins,
+                trim_ratio=cfg.trim_ratio, maxiter=cfg.gm2_maxiter,
+                ctx=ctx,
+            )
+        if cfg.sign_bits == 1:
+            # the packed one-bit wire: this edge's rows pack to uint32
+            # sign words locally; only the per-coordinate plane COUNTS
+            # (bounded by rows-per-edge, so uint8/uint16 on the wire)
+            # and the finite-row ballot count cross the network
+            rows = jax.lax.dynamic_slice(
+                stack, (self.shard * cfg.rows_per_edge, 0),
+                (cfg.rows_per_edge, d),
+            )
+            words, k_valid = aggregators.pack_signs(
+                rows, jnp.zeros(d, jnp.float32)
+            )
+            counts = aggregators.packed_sign_votes(words, d)
+            m_counts, m_valid = ctx.merge(
+                (counts, k_valid), ("sum", "sum"),
+                meta={"label": "signvote"},
+            )
+            outs["signvote"] = (2 * m_counts - m_valid).astype(jnp.int32)
+        # result consensus: every edge computed bit-identical finals
+        # (they are functions of merged data only); the root verifies
+        # byte-equality across the fleet and quarantines dissenters
+        names = cfg.result_names
+        merged = self.ctx.merge(
+            tuple(outs[n] for n in names),
+            ("same",) * len(names),
+            meta={"label": "results", "names": names},
+        )
+        return dict(zip(names, merged))
+
+
+# --------------------------------------------------------------------------
+# the HTTP client half (stdlib urllib; the root is serve/root.py)
+# --------------------------------------------------------------------------
+
+
+class EdgeClient:
+    """Signed, nonce'd submissions plus fold polling for one edge."""
+
+    def __init__(self, root_url: str, edge: int, key_hex: str,
+                 poll_secs: float = 0.02, timeout: float = 30.0) -> None:
+        self.root_url = root_url.rstrip("/")
+        self.edge = edge
+        self.key_hex = key_hex
+        self.poll_secs = poll_secs
+        self.timeout = timeout
+        self._nonce = 0
+        self._round = -1
+        self._epoch = 0
+        self._seq = 0
+
+    # --------------------------------------------------------- plumbing
+
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None) -> Tuple[int, dict]:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.root_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, json.loads(resp.read().decode() or "{}")
+        except urllib.error.HTTPError as exc:
+            raw = exc.read().decode(errors="replace")
+            try:
+                return exc.code, json.loads(raw or "{}")
+            except json.JSONDecodeError:
+                return exc.code, {"error": raw}
+
+    def _raise_for(self, status: int, resp: dict) -> None:
+        if status == 410:
+            raise EdgeQuarantined(str(resp.get("error", "")))
+        if status == 409 and resp.get("error") == "stale_epoch":
+            raise RoundRestart(int(resp.get("epoch", self._epoch + 1)))
+        raise RuntimeError(
+            f"edge {self.edge}: root answered {status}: {resp}"
+        )
+
+    def _signed(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        self._nonce += 1
+        body = {**body, "edge": self.edge, "nonce": self._nonce}
+        body["mac"] = sign_envelope(self.key_hex, body)
+        return body
+
+    # --------------------------------------------------------- protocol
+
+    def begin_round(self, rnd: int, epoch: int) -> None:
+        self._round, self._epoch, self._seq = rnd, epoch, 0
+
+    def round_state(self, rnd: int) -> dict:
+        status, resp = self._request("GET", f"/rounds/{rnd}")
+        if status != 200:
+            self._raise_for(status, resp)
+        return resp
+
+    def exchange(self, tags, arrays, meta: Optional[dict] = None):
+        """The EdgeShardCtx host callback: POST this shard's partial for
+        the current (round, epoch, seq), then poll the fold."""
+        from ..ops import shardctx
+
+        seq = self._seq
+        self._seq += 1
+        body = self._signed({
+            "op": "partial",
+            "round": self._round,
+            "epoch": self._epoch,
+            "seq": seq,
+            "meta": meta or {},
+            **shardctx.partial_to_wire(arrays, tags),
+        })
+        status, resp = self._request("POST", "/partials", body)
+        if status != 200:
+            self._raise_for(status, resp)
+        path = (
+            f"/fold/{self._round}/{seq}"
+            f"?epoch={self._epoch}&edge={self.edge}"
+        )
+        deadline = time.time() + self.timeout
+        while True:
+            status, resp = self._request("GET", path)
+            if status == 200:
+                leaves, _ = shardctx.partial_from_wire(resp)
+                return leaves
+            if status == 202:
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        f"edge {self.edge}: fold of round {self._round} "
+                        f"seq {seq} never completed"
+                    )
+                time.sleep(self.poll_secs)
+                continue
+            self._raise_for(status, resp)
+
+    def done(self, rnd: int) -> None:
+        body = self._signed({
+            "op": "done", "round": rnd, "epoch": self._epoch,
+        })
+        status, resp = self._request("POST", "/done", body)
+        if status != 200:
+            self._raise_for(status, resp)
+
+
+# --------------------------------------------------------------------------
+# the edge main loop
+# --------------------------------------------------------------------------
+
+
+def _classify(exc: BaseException) -> Optional[str]:
+    """Map an exception that crossed the XLA callback boundary back to
+    the protocol signal its message carries."""
+    msg = str(exc)
+    if RESTART_MARKER in msg:
+        return "restart"
+    if DEAD_MARKER in msg:
+        return "dead"
+    return None
+
+
+def run_edge(cfg: TopologyConfig, shard: int, root_url: str,
+             obs_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Run one edge through every round; returns a summary dict.
+
+    Exit invariants (the chaos harness asserts them via the return/exit
+    code): all rounds completed or this edge was quarantined, and the
+    retrace audit passed — each round program lowered at most once."""
+    import jax
+
+    from .. import obs as obs_lib
+
+    sink = (
+        obs_lib.JsonlSink(f"{obs_dir}/edge{shard}.events.jsonl")
+        if obs_dir else obs_lib.MemorySink()
+    )
+    # the fold-poll deadline must OUTLIVE the root's partial_timeout: a
+    # survivor waiting on a phase a dead edge never joins has to still be
+    # polling when the root quarantines the deadbeat and answers 409
+    client = EdgeClient(
+        root_url, shard, cfg.keys[shard],
+        timeout=max(30.0, cfg.partial_timeout * 2 + 30.0),
+    )
+    compute = EdgeCompute(cfg, shard, client.exchange)
+    status = "completed"
+    rounds_run = 0
+    try:
+        for rnd in range(cfg.rounds):
+            stack = round_stack(cfg.seed, rnd, cfg.k, cfg.d)
+            while True:
+                state = client.round_state(rnd)
+                live = list(state.get("live", []))
+                if shard not in live:
+                    raise EdgeQuarantined("not in live set")
+                client.begin_round(rnd, int(state.get("epoch", 0)))
+                degraded = len(live) < cfg.edges
+                try:
+                    out = compute.round_fn(degraded)(stack)
+                    jax.block_until_ready(out)
+                    client.done(rnd)
+                    rounds_run += 1
+                    break
+                except Exception as exc:  # noqa: BLE001 — see _classify
+                    kind = _classify(exc)
+                    if kind == "restart":
+                        continue
+                    raise
+    except EdgeQuarantined:
+        status = "quarantined"
+    except Exception as exc:  # noqa: BLE001
+        if _classify(exc) == "dead":
+            status = "quarantined"
+        else:
+            raise
+    counts = compute.detector.snapshot()
+    steady = all(
+        compute.detector.check(name, max_lowerings=1)
+        for name in counts
+    )
+    sink.emit(obs_lib.make_event(
+        "retrace", counts=counts, steady_state_ok=steady,
+    ))
+    sink.close()
+    return {
+        "edge": shard,
+        "status": status,
+        "rounds": rounds_run,
+        "lowerings": counts,
+        "steady_state_ok": steady,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "byzantine_aircomp_tpu edge",
+        description="one edge aggregator of the 2-tier topology",
+    )
+    p.add_argument("--config", required=True,
+                   help="topology JSON (shared with the root)")
+    p.add_argument("--shard", type=int, required=True,
+                   help="this edge's shard index in [0, edges)")
+    p.add_argument("--root-url", required=True,
+                   help="root base URL, e.g. http://127.0.0.1:8123")
+    p.add_argument("--obs-dir", default=None,
+                   help="directory for this edge's event stream")
+    args = p.parse_args(argv)
+    # the ordered io_callback logs a full traceback at ERROR for every
+    # protocol exception (epoch restarts are routine, not errors)
+    import logging
+
+    logging.getLogger("jax._src.callback").setLevel(logging.CRITICAL)
+    cfg = TopologyConfig.load(args.config)
+    summary = run_edge(cfg, args.shard, args.root_url, args.obs_dir)
+    print(f"edge {args.shard}: {json.dumps(summary)}", flush=True)
+    if not summary["steady_state_ok"]:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -142,3 +142,45 @@ def replay(
                 )
             del states[run_id]
     return states
+
+
+#: root-journal file name under the aggregation root's obs dir
+ROOT_JOURNAL_NAME = "root_journal.jsonl"
+
+#: root-journal ops (run_id is ``edge-<id>``): ``partial`` carries the
+#: per-round accepted-nonce high-water mark (written at round close, not
+#: per exchange — a round is ~100 exchanges and the HWM is all restart
+#: recovery needs), ``replay_rejected`` / ``forged_rejected`` record the
+#: zero-trust rejections with the offending nonce, ``edge_quarantined``
+#: the containment decision, and ``round_done`` the fleet-level close.
+
+
+def replay_edges(
+    path: str, warn: Optional[Callable[[str], None]] = None
+) -> Dict[int, Dict[str, Any]]:
+    """Fold a ROOT journal into per-edge security state.
+
+    Returns ``edge -> {"nonce": hwm, "quarantined": reason | None}``.  A
+    restarted root restores the nonce high-water marks BEFORE serving, so
+    a replay of a submission captured before the crash is still rejected
+    — the idempotency machinery the run journal uses for run adoption,
+    reused for replay protection.  Quarantines are permanent across
+    restarts: a contained edge stays contained until the operator rotates
+    its key and clears the journal (docs/RUNBOOK.md).
+    """
+    states: Dict[int, Dict[str, Any]] = {}
+    for rec in io_lib.iter_jsonl(path, warn=warn):
+        run_id = rec.get("run_id")
+        if not isinstance(run_id, str) or not run_id.startswith("edge-"):
+            continue
+        try:
+            edge = int(run_id[5:])
+        except ValueError:
+            continue
+        st = states.setdefault(edge, {"nonce": 0, "quarantined": None})
+        nonce = rec.get("nonce")
+        if isinstance(nonce, int):
+            st["nonce"] = max(st["nonce"], nonce)
+        if rec.get("op") == "edge_quarantined":
+            st["quarantined"] = rec.get("reason", "unknown")
+    return states

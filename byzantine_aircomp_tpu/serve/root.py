@@ -1,0 +1,585 @@
+"""Aggregation root: the trusted fold point of the 2-tier topology.
+
+Edges (serve/edge.py) POST canonical wire partials; the root verifies
+them (HMAC, nonce monotonicity, epoch currency, finiteness, shape/tag
+consistency), folds each complete phase with the SAME
+``ops/shardctx.fold_leaves`` left fold in shard order the sequential
+engine uses — so tree == mesh == sequential stays bit-identical — and
+hands the fold back to polling edges.  Zero-trust posture:
+
+* a forged MAC never reaches the fold: it is rejected before decode,
+  journaled (``forged_rejected``) and counted; repeated forgeries only
+  ever cost strikes — they can NOT quarantine the edge whose identity
+  they claim, or any attacker could evict the fleet edge by edge.
+* a replayed nonce under a VALID mac means the channel itself is
+  compromised (the key leaked or the edge is duplicated), so it is
+  rejected (409), journaled (``replay_rejected``) AND the edge is
+  quarantined immediately.
+* a partial that fails decode / finite / shape checks quarantines its
+  edge (``bad_payload`` / ``nonfinite_partial``) — the lane-eviction
+  pattern from the batch runner applied one level up.
+* a missing partial past ``partial_timeout`` quarantines the silent
+  edges and bumps the round's EPOCH: survivors see ``stale_epoch`` on
+  their next request, re-read the live set, and re-run the round in
+  degraded mode (the effective-K guards take it from there).  Deadlines
+  are checked at the top of every route dispatch — edges and harnesses
+  poll continuously, so a dedicated timer thread would buy nothing.
+
+The final exchange of every round carries each edge's RESULT arrays
+under the ``"same"`` consensus tag: results are functions of merged data
+only, so honest edges agree byte-for-byte.  The root byte-majority
+votes, stores the winners as the round's results, and quarantines
+dissenters (``result_mismatch``) — a compromised edge cannot poison the
+published aggregate without out-voting the fleet.
+
+The numeric fold runs under ``jax.jit`` wrapped by the retrace detector;
+each distinct (tags, shapes, live-count) phase signature legitimately
+lowers once, and ``/results`` reports ``fold_lowerings`` ==
+``fold_signatures`` so the chaos harness can assert the root never
+recompiles mid-run.  Nonce high-water marks persist to a root journal
+(``serve/journal.py``) and are restored before serving, so replay
+protection survives a root restart.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hmac as hmac_lib
+import json
+import os
+import threading
+import time
+import urllib.parse
+from collections import Counter
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import obs as obs_lib
+from ..ops import shardctx
+from . import journal as journal_lib
+from .edge import TopologyConfig, sign_envelope
+
+_JSON = "application/json"
+
+
+class Reject(Exception):
+    """A verified-bad submission: carries the HTTP status + payload."""
+
+    def __init__(self, status: int, **payload: Any) -> None:
+        super().__init__(payload.get("error", "rejected"))
+        self.status = status
+        self.payload = payload
+
+
+class RootState:
+    """All root bookkeeping behind one lock (HTTP handler threads)."""
+
+    def __init__(
+        self,
+        cfg: TopologyConfig,
+        obs_dir: Optional[str] = None,
+        registry=None,
+        now_fn=time.time,
+    ) -> None:
+        self.cfg = cfg
+        self.now = now_fn
+        self._lock = threading.RLock()
+        self.registry = (
+            registry if registry is not None else obs_lib.MetricsRegistry()
+        )
+        if obs_dir:
+            os.makedirs(obs_dir, exist_ok=True)
+            self.sink: Any = obs_lib.MultiSink([
+                obs_lib.JsonlSink(os.path.join(obs_dir, "root.events.jsonl")),
+                obs_lib.MetricsSink(self.registry),
+            ])
+            self.journal = journal_lib.RunJournal(
+                os.path.join(obs_dir, journal_lib.ROOT_JOURNAL_NAME)
+            )
+        else:
+            self.sink = obs_lib.MetricsSink(self.registry)
+            self.journal = None
+        self.live = set(range(cfg.edges))
+        self.quarantined: Dict[int, str] = {}
+        self.nonces: Dict[int, int] = {e: 0 for e in range(cfg.edges)}
+        self.strikes: Dict[int, int] = {}
+        self.epoch = 0
+        # (round, epoch, seq) -> phase dict
+        self.phases: Dict[Tuple[int, int, int], Dict[str, Any]] = {}
+        # round -> {"ingress", "done", "completed", "results",
+        #           "done_first_ts", "epoch"}
+        self.rounds: Dict[int, Dict[str, Any]] = {}
+        self.detector = obs_lib.RetraceDetector()
+        self._fold_jit = None
+        self._fold_sigs: set = set()
+        self._restore()
+
+    # ----------------------------------------------------------- restore
+
+    def _restore(self) -> None:
+        """Replay the root journal: nonce HWMs and standing quarantines
+        survive a root restart, so captured submissions stay dead."""
+        if self.journal is None:
+            return
+        states = journal_lib.replay_edges(
+            self.journal.path,
+            warn=lambda m: print(f"[root] {m}", flush=True),
+        )
+        for edge, st in states.items():
+            if edge in self.nonces:
+                self.nonces[edge] = max(self.nonces[edge], st["nonce"])
+            if st["quarantined"] and edge in self.live:
+                self.live.discard(edge)
+                self.quarantined[edge] = st["quarantined"]
+
+    # ------------------------------------------------------- observation
+
+    def _emit(self, kind: str, **fields: Any) -> None:
+        self.sink.emit(obs_lib.make_event(kind, **fields))
+
+    def _journal(self, op: str, edge: int, **fields: Any) -> None:
+        if self.journal is not None:
+            self.journal.append(op, f"edge-{edge}", **fields)
+
+    # ------------------------------------------------------- containment
+
+    def _quarantine(self, edge: int, reason: str, bump: bool = True) -> None:
+        """Evict ``edge``; optionally bump the epoch so in-flight phases
+        restart over the surviving set (consensus dissent does NOT bump —
+        the fold already completed over the majority)."""
+        if edge in self.quarantined:
+            return
+        self.live.discard(edge)
+        self.quarantined[edge] = reason
+        self._journal("edge_quarantined", edge, reason=reason)
+        self._emit("edge_quarantine", edge=edge, reason=reason)
+        if bump:
+            self.epoch += 1
+            # stale-epoch phases can never fold; drop them
+            self.phases = {
+                key: ph for key, ph in self.phases.items()
+                if key[1] >= self.epoch
+            }
+
+    def _reject(self, edge: int, reason: str, status: int,
+                journal_op: Optional[str] = None, **extra: Any) -> Reject:
+        self.strikes[edge] = self.strikes.get(edge, 0) + 1
+        if journal_op:
+            self._journal(journal_op, edge, reason=reason, **extra)
+        self._emit("edge_reject", edge=edge, reason=reason)
+        return Reject(status, error=reason, **extra)
+
+    # ------------------------------------------------------ verification
+
+    def _verify(self, body: Any, op: str) -> int:
+        """The zero-trust chain; returns the verified edge id or raises
+        :class:`Reject`.  Order matters: identity before authenticity,
+        authenticity before ANY stateful reaction, replay/epoch before
+        decode — an unauthenticated byte never changes fold state."""
+        if not isinstance(body, dict) or body.get("op") != op:
+            raise Reject(400, error=f"body must be a signed {op!r} envelope")
+        edge = body.get("edge")
+        if not isinstance(edge, int) or edge not in self.nonces:
+            raise Reject(401, error="unknown edge")
+        mac = body.get("mac")
+        want = sign_envelope(self.cfg.keys[edge], body)
+        if not (isinstance(mac, str) and hmac_lib.compare_digest(mac, want)):
+            raise self._reject(
+                edge, "bad_mac", 401, journal_op="forged_rejected",
+                nonce=body.get("nonce"),
+            )
+        # authenticated from here on
+        if edge in self.quarantined:
+            raise Reject(410, error=self.quarantined[edge])
+        nonce = body.get("nonce")
+        if not isinstance(nonce, int) or nonce <= self.nonces[edge]:
+            # a VALID mac with a reused nonce is a captured-and-replayed
+            # submission: the channel is compromised, contain the edge
+            exc = self._reject(
+                edge, "replay", 409, journal_op="replay_rejected",
+                nonce=nonce,
+            )
+            self._quarantine(edge, "replayed_nonce")
+            raise exc
+        if body.get("epoch") != self.epoch:
+            raise Reject(409, error="stale_epoch", epoch=self.epoch)
+        rnd = body.get("round")
+        if not isinstance(rnd, int) or not 0 <= rnd < self.cfg.rounds:
+            raise Reject(400, error=f"round {rnd!r} out of range")
+        self.nonces[edge] = nonce
+        return edge
+
+    # ------------------------------------------------------------- folds
+
+    def _fold(self, key: Tuple[int, int, int], phase: Dict[str, Any]) -> None:
+        order = sorted(phase["subs"])
+        tags = phase["tags"]
+        subs = phase["subs"]
+        if all(t == "same" for t in tags):
+            # result consensus: majority bytes win, dissenters are
+            # contained without an epoch bump (the fold stands)
+            n_leaves = len(subs[order[0]])
+            winners: List[np.ndarray] = []
+            dissent: set = set()
+            for i in range(n_leaves):
+                blobs = {e: subs[e][i].tobytes() for e in order}
+                votes = Counter(blobs.values())
+                best = max(votes.values())
+                # majority wins; a tie resolves to the first edge in
+                # shard order (deterministic, and with >2/3 honest edges
+                # a tie can only happen when every submission disagrees)
+                win_edge = next(e for e in order if votes[blobs[e]] == best)
+                winners.append(subs[win_edge][i])
+                dissent |= {
+                    e for e in order if blobs[e] != blobs[win_edge]
+                }
+            phase["folded"] = winners
+            names = (phase.get("meta") or {}).get("names")
+            if names and len(names) == len(winners):
+                rst = self._round(key[0])
+                rst["results"] = {
+                    n: w for n, w in zip(names, winners)
+                }
+            for e in sorted(dissent):
+                self._quarantine(e, "result_mismatch", bump=False)
+            return
+        stacked = tuple(
+            np.stack([subs[e][i] for e in order])
+            for i in range(len(subs[order[0]]))
+        )
+        n = len(order)
+        if self._fold_jit is None:
+            import jax
+
+            self._fold_jit = jax.jit(
+                self.detector.wrap("root_fold_fn", self._fold_body),
+                static_argnames=("tags", "n"),
+            )
+        sig = (
+            tuple(tags), n,
+            tuple((s.shape, str(s.dtype)) for s in stacked),
+        )
+        self._fold_sigs.add(sig)
+        out = self._fold_jit(stacked, tags=tuple(tags), n=n)
+        phase["folded"] = [np.asarray(x, order="C") for x in out]
+
+    @staticmethod
+    def _fold_body(stacked, *, tags, n):
+        return shardctx.fold_partials(stacked, tags, n)
+
+    # ---------------------------------------------------------- deadline
+
+    def _round(self, rnd: int) -> Dict[str, Any]:
+        return self.rounds.setdefault(rnd, {
+            "ingress": 0, "done": set(), "completed": False,
+            "results": {}, "done_first_ts": None, "epoch": self.epoch,
+        })
+
+    def deadline_check(self, now: Optional[float] = None) -> None:
+        """Quarantine edges that keep a phase (or a round close) waiting
+        past ``partial_timeout``.  Called at the top of every dispatch —
+        the fleet polls continuously, so wall-clock progress is free."""
+        now = self.now() if now is None else now
+        with self._lock:
+            timeout = self.cfg.partial_timeout
+            for key, phase in list(self.phases.items()):
+                if phase.get("folded") is not None:
+                    continue
+                if key[1] != self.epoch:
+                    continue
+                if now - phase["first_ts"] <= timeout:
+                    continue
+                for e in sorted(self.live - set(phase["subs"])):
+                    self._quarantine(e, "partial_timeout")
+            for rnd, rst in self.rounds.items():
+                ts = rst.get("done_first_ts")
+                if rst["completed"] or ts is None:
+                    continue
+                if now - ts <= self.cfg.partial_timeout:
+                    continue
+                for e in sorted(self.live - rst["done"]):
+                    self._quarantine(e, "partial_timeout")
+                self._maybe_complete(rnd)
+
+    # ------------------------------------------------------------ routes
+
+    def submit_partial(self, raw: bytes) -> Tuple[int, Dict[str, Any]]:
+        with self._lock:
+            try:
+                body = json.loads(raw.decode())
+            except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+                return 400, {"error": f"bad json: {exc}"}
+            try:
+                edge = self._verify(body, "partial")
+                seq = body.get("seq")
+                if not isinstance(seq, int) or seq < 0:
+                    raise Reject(400, error=f"bad seq {seq!r}")
+                try:
+                    leaves, tags = shardctx.partial_from_wire(body)
+                except ValueError as exc:
+                    self._quarantine(edge, "bad_payload")
+                    raise Reject(422, error=f"bad payload: {exc}")
+                for x in leaves:
+                    if x.dtype.kind == "f" and not np.isfinite(x).all():
+                        self._quarantine(edge, "nonfinite_partial")
+                        raise Reject(422, error="nonfinite partial")
+                rnd = body["round"]
+                key = (rnd, self.epoch, seq)
+                phase = self.phases.setdefault(key, {
+                    "subs": {}, "tags": tags, "meta": body.get("meta"),
+                    "first_ts": self.now(), "folded": None,
+                    "shapes": [(x.shape, x.dtype.str) for x in leaves],
+                })
+                if (
+                    list(tags) != list(phase["tags"])
+                    or [(x.shape, x.dtype.str) for x in leaves]
+                    != phase["shapes"]
+                ):
+                    self._quarantine(edge, "bad_payload")
+                    raise Reject(
+                        422, error="partial disagrees with phase schema"
+                    )
+                phase["subs"][edge] = leaves
+                rst = self._round(rnd)
+                rst["ingress"] += len(raw)
+                self._emit(
+                    "edge_partial", round=rnd, edge=edge, seq=seq,
+                    bytes=len(raw),
+                )
+                if self.live <= set(phase["subs"]):
+                    self._fold(key, phase)
+                return 200, {"ok": True, "seq": seq}
+            except Reject as exc:
+                return exc.status, exc.payload
+
+    def get_fold(self, rnd: int, seq: int, epoch: int,
+                 edge: Optional[int]) -> Tuple[int, Dict[str, Any]]:
+        with self._lock:
+            if edge is not None and edge in self.quarantined:
+                return 410, {"error": self.quarantined[edge]}
+            if epoch != self.epoch:
+                return 409, {"error": "stale_epoch", "epoch": self.epoch}
+            phase = self.phases.get((rnd, epoch, seq))
+            if phase is None or phase.get("folded") is None:
+                return 202, {"pending": True}
+            return 200, shardctx.partial_to_wire(
+                phase["folded"], phase["tags"]
+            )
+
+    def submit_done(self, raw: bytes) -> Tuple[int, Dict[str, Any]]:
+        with self._lock:
+            try:
+                body = json.loads(raw.decode())
+            except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+                return 400, {"error": f"bad json: {exc}"}
+            try:
+                edge = self._verify(body, "done")
+            except Reject as exc:
+                return exc.status, exc.payload
+            rnd = body["round"]
+            rst = self._round(rnd)
+            rst["done"].add(edge)
+            if rst["done_first_ts"] is None:
+                rst["done_first_ts"] = self.now()
+            self._maybe_complete(rnd)
+            return 200, {"ok": True, "completed": rst["completed"]}
+
+    def _maybe_complete(self, rnd: int) -> None:
+        rst = self.rounds.get(rnd)
+        if rst is None or rst["completed"]:
+            return
+        if not self.live or not self.live <= rst["done"]:
+            return
+        rst["completed"] = True
+        rst["epoch"] = self.epoch
+        degraded = len(self.live) < self.cfg.edges
+        rst["degraded"] = degraded
+        self._emit(
+            "edge_round", round=rnd, epoch=self.epoch,
+            edges=len(self.live), degraded=degraded,
+            ingress_bytes=rst["ingress"],
+        )
+        for e in sorted(self.live):
+            self._journal(
+                "partial", e, round=rnd, nonce=self.nonces[e],
+            )
+            self._journal("round_done", e, round=rnd, epoch=self.epoch)
+        # phase payloads for a closed round are dead weight; drop them
+        self.phases = {
+            key: ph for key, ph in self.phases.items() if key[0] != rnd
+        }
+
+    def round_info(self, rnd: int) -> Dict[str, Any]:
+        with self._lock:
+            rst = self.rounds.get(rnd)
+            return {
+                "round": rnd,
+                "epoch": self.epoch,
+                "live": sorted(self.live),
+                "completed": bool(rst and rst["completed"]),
+            }
+
+    def results(self) -> Dict[str, Any]:
+        with self._lock:
+            rounds = {}
+            for rnd, rst in sorted(self.rounds.items()):
+                rounds[str(rnd)] = {
+                    "completed": rst["completed"],
+                    "epoch": rst["epoch"],
+                    "ingress_bytes": rst["ingress"],
+                    "degraded": rst.get(
+                        "degraded", len(self.live) < self.cfg.edges
+                    ),
+                    "results": {
+                        n: shardctx.encode_leaf(v)
+                        for n, v in rst["results"].items()
+                    },
+                }
+            return {
+                "epoch": self.epoch,
+                "live": sorted(self.live),
+                "quarantined": dict(self.quarantined),
+                "strikes": dict(self.strikes),
+                "rounds": rounds,
+                "fold_lowerings": self.detector.count("root_fold_fn"),
+                "fold_signatures": len(self._fold_sigs),
+            }
+
+    def all_done(self) -> bool:
+        with self._lock:
+            return all(
+                self.rounds.get(r, {}).get("completed")
+                for r in range(self.cfg.rounds)
+            )
+
+    def close(self) -> None:
+        self.sink.close()
+        if self.journal is not None:
+            self.journal.close()
+
+
+class RootServer:
+    """One socket: the edge protocol + /metrics + /healthz."""
+
+    def __init__(
+        self,
+        cfg: TopologyConfig,
+        obs_dir: Optional[str] = None,
+        port: int = 0,
+        host: str = "0.0.0.0",
+    ) -> None:
+        self.state = RootState(cfg, obs_dir=obs_dir)
+        self.exporter = obs_lib.MetricsExporter(
+            self.state.registry,
+            port=port,
+            host=host,
+            health_fn=self._health,
+            routes=self._routes,
+        )
+
+    @property
+    def port(self) -> Optional[int]:
+        return self.exporter.port
+
+    def start(self) -> "RootServer":
+        self.exporter.start()
+        return self
+
+    def close(self) -> None:
+        self.exporter.close()
+        self.state.close()
+
+    def __enter__(self) -> "RootServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _health(self) -> Dict[str, Any]:
+        st = self.state
+        return {
+            "ok": bool(st.live),
+            "live": sorted(st.live),
+            "quarantined": dict(st.quarantined),
+            "epoch": st.epoch,
+        }
+
+    @staticmethod
+    def _json(status: int, payload: Any) -> Tuple[int, str, bytes]:
+        return status, _JSON, (json.dumps(payload) + "\n").encode()
+
+    def _routes(
+        self, method: str, path: str, body: bytes,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> Optional[Tuple[int, str, bytes]]:
+        url = urllib.parse.urlsplit(path)
+        parts = [p for p in url.path.split("/") if p]
+        if not parts:
+            return None
+        # wall-clock progress rides every request — see deadline_check
+        self.state.deadline_check()
+        try:
+            if parts[0] == "partials" and method == "POST":
+                return self._json(*self.state.submit_partial(body))
+            if parts[0] == "done" and method == "POST":
+                return self._json(*self.state.submit_done(body))
+            if parts[0] == "fold" and len(parts) == 3 and method == "GET":
+                q = urllib.parse.parse_qs(url.query)
+                edge = q.get("edge", [None])[0]
+                return self._json(*self.state.get_fold(
+                    int(parts[1]), int(parts[2]),
+                    int(q.get("epoch", ["0"])[0]),
+                    int(edge) if edge is not None else None,
+                ))
+            if parts[0] == "rounds" and len(parts) == 2 and method == "GET":
+                return self._json(200, self.state.round_info(int(parts[1])))
+            if parts[0] == "results" and method == "GET":
+                return self._json(200, self.state.results())
+        except ValueError as exc:
+            return self._json(400, {"error": str(exc)})
+        except Exception as exc:  # noqa: BLE001 — surface, don't kill thread
+            return self._json(500, {"error": f"{type(exc).__name__}: {exc}"})
+        return None
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "byzantine_aircomp_tpu root",
+        description="aggregation root of the 2-tier topology",
+    )
+    p.add_argument("--config", required=True,
+                   help="topology JSON (shared with the edges)")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--obs-dir", default=None,
+                   help="events + root journal directory")
+    p.add_argument("--linger", type=float, default=5.0,
+                   help="seconds to keep serving after all rounds close "
+                        "(lets the harness scrape /results)")
+    args = p.parse_args(argv)
+    cfg = TopologyConfig.load(args.config)
+    server = RootServer(
+        cfg, obs_dir=args.obs_dir, port=args.port, host=args.host
+    ).start()
+    # parsed by the chaos harness; keep the trailing space (port parse)
+    print(f"edge root on {args.host}:{server.port} ", flush=True)
+    try:
+        while not server.state.all_done():
+            time.sleep(0.1)
+            server.state.deadline_check()
+            if not server.state.live:
+                print("edge root: all edges quarantined", flush=True)
+                break
+        time.sleep(args.linger)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        results = server.state.results()
+        server.close()
+        print(f"edge root results: {json.dumps(results)}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

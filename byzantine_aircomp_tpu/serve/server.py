@@ -55,7 +55,13 @@ class ExperimentServer:
         run_backoff: float = 2.0,
         wedge_secs: float = 0.0,
         recover: bool = True,
+        auth_token: Optional[str] = None,
     ) -> None:
+        # optional bearer auth on the MUTATING surface only: submissions,
+        # cancels and knob swaps change tenant state, so they 401 without
+        # the token; /metrics, /healthz and the read-only GETs stay open
+        # for scrapers and dashboards
+        self.auth_token = auth_token
         self.registry = obs_lib.MetricsRegistry()
         self.manager = RunManager(
             obs_root,
@@ -118,11 +124,30 @@ class ExperimentServer:
     def _json(status: int, payload: Any) -> Tuple[int, str, bytes]:
         return status, _JSON, (json.dumps(payload) + "\n").encode()
 
+    def _authorized(self, headers: Dict[str, str]) -> bool:
+        if self.auth_token is None:
+            return True
+        auth = headers.get("authorization", "")
+        supplied = auth[7:] if auth.startswith("Bearer ") else ""
+        # constant-time compare — a token check that leaks prefix length
+        # through timing is not a token check
+        import hmac as _hmac
+
+        return _hmac.compare_digest(supplied, self.auth_token)
+
     def _routes(
-        self, method: str, path: str, body: bytes
+        self, method: str, path: str, body: bytes,
+        headers: Optional[Dict[str, str]] = None,
     ) -> Optional[Tuple[int, str, bytes]]:
         """The exporter's extra-route hook; ``None`` falls through to the
         built-in ``/metrics``/``/healthz`` handling."""
+        path = path.split("?", 1)[0]
+        if (
+            method == "POST"
+            and path.split("/", 2)[1:2] == ["runs"]
+            and not self._authorized(headers or {})
+        ):
+            return self._json(401, {"error": "unauthorized"})
         try:
             return self._dispatch(method, path, body)
         except KeyError as exc:

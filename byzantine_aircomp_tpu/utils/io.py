@@ -58,20 +58,38 @@ def open_append(path: str):
 
 
 def iter_jsonl(
-    path: str, warn: Optional[Callable[[str], None]] = None
+    path: str,
+    warn: Optional[Callable[[str], None]] = None,
+    max_warn: int = 10,
 ) -> Iterator[dict]:
     """Yield parsed objects from a JSONL file, skipping undecodable lines.
 
     The read-side counterpart to :func:`open_append`: line-buffered
     appends mean a kill can tear AT MOST the final line (a partial write
-    the OS flushed on process death), so a loader that raised on it would
-    turn one lost line into a lost stream.  Every torn/garbage line is
-    skipped through ``warn`` (once per line); byte truncation that splits
-    a multibyte character is absorbed by ``errors="replace"``.  A missing
-    file yields nothing — callers distinguish empty from absent with
-    ``os.path.exists`` if they care."""
+    the OS flushed on process death) — but disk corruption, a crashed
+    writer without line buffering, or a hostile file can damage INTERIOR
+    lines too, and journal/event-stream replay must survive both: every
+    torn/garbage/non-object line is skipped, never raised.  Skips are
+    COUNTED: the first ``max_warn`` report per line through ``warn``,
+    the rest are silent (a corrupt 100k-line stream must not flood the
+    operator's terminal), and when any skips went UNREPORTED a summary
+    line with the total closes the iteration — the caller always learns
+    HOW MUCH is missing even past the cap; below the cap every skip was
+    already reported individually, so no summary is added.  Byte
+    truncation that splits a multibyte character is absorbed
+    by ``errors="replace"``.  A missing file yields nothing — callers
+    distinguish empty from absent with ``os.path.exists`` if they
+    care."""
     if not os.path.exists(path):
         return
+    skipped = 0
+
+    def _skip(i: int, why: str) -> None:
+        nonlocal skipped
+        skipped += 1
+        if warn is not None and skipped <= max_warn:
+            warn(f"skipping {why} line {i + 1} of {path}")
+
     with open(path, "r", errors="replace") as f:
         for i, line in enumerate(f):
             line = line.strip()
@@ -79,11 +97,15 @@ def iter_jsonl(
                 continue
             try:
                 obj = json.loads(line)
-            except json.JSONDecodeError:
-                if warn is not None:
-                    warn(f"skipping malformed line {i + 1} of {path}")
+            except (json.JSONDecodeError, ValueError):
+                _skip(i, "malformed")
                 continue
             if isinstance(obj, dict):
                 yield obj
-            elif warn is not None:
-                warn(f"skipping non-object line {i + 1} of {path}")
+            else:
+                _skip(i, "non-object")
+    if skipped > max_warn and warn is not None:
+        warn(
+            f"{path}: skipped {skipped} unreadable line(s) total"
+            f" ({skipped - max_warn} unreported)"
+        )

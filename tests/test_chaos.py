@@ -48,6 +48,65 @@ def test_iter_jsonl_skips_torn_tail(tmp_path):
     assert len(warnings) == 1 and "line 3" in warnings[0]
 
 
+def test_iter_jsonl_survives_interior_corruption_with_counted_warning(
+    tmp_path,
+):
+    """Corruption is not only a torn tail: disk damage or a hostile
+    writer can garble INTERIOR lines.  Every intact object before AND
+    after the damage must still come through, per-line warnings are
+    capped at ``max_warn``, and one summary reports the TOTAL skipped —
+    the caller learns how much is missing, not just that something is."""
+    p = tmp_path / "stream.jsonl"
+    lines = []
+    for i in range(30):
+        lines.append(json.dumps({"n": i}))
+        if i < 25:  # garbage sprinkled through the middle of the file
+            lines.append('{"torn": ' + "x" * (i + 1))
+    p.write_text("\n".join(lines) + "\n")
+    warnings = []
+    rows = list(iter_jsonl(str(p), warn=warnings.append, max_warn=10))
+    assert [r["n"] for r in rows] == list(range(30))
+    assert len(warnings) == 11  # 10 per-line + 1 summary
+    assert all("malformed" in w for w in warnings[:10])
+    assert "skipped 25 unreadable line(s) total" in warnings[-1]
+    assert "(15 unreported)" in warnings[-1]
+
+
+def test_iter_jsonl_summary_only_past_the_warning_cap(tmp_path):
+    p = tmp_path / "clean.jsonl"
+    p.write_text('{"ok": 1}\n{"ok": 2}\n')
+    warnings = []
+    assert len(list(iter_jsonl(str(p), warn=warnings.append))) == 2
+    assert warnings == []
+    # below the cap every skip was already reported individually — no
+    # summary line (callers counting exact warnings rely on this)
+    p2 = tmp_path / "two_bad.jsonl"
+    p2.write_text('{"ok": 1}\nGARBAGE\n{"ok": 2}\n[3]\n')
+    warnings = []
+    rows = list(iter_jsonl(str(p2), warn=warnings.append))
+    assert [r["ok"] for r in rows] == [1, 2]
+    assert len(warnings) == 2
+    assert all("unreadable line(s) total" not in w for w in warnings)
+
+
+def test_root_journal_replay_survives_interior_corruption(tmp_path):
+    """The root journal's security state (nonce HWMs, quarantines) must
+    fold correctly around a damaged middle line."""
+    from byzantine_aircomp_tpu.serve.journal import RunJournal, replay_edges
+
+    path = str(tmp_path / "root_journal.jsonl")
+    jr = RunJournal(path)
+    jr.append("partial", "edge-0", round=0, nonce=5)
+    jr.append("edge_quarantined", "edge-1", reason="partial_timeout")
+    jr.close()
+    raw = open(path, "rb").read().splitlines()
+    raw.insert(1, b'{"op": "partial", "run_id": "edge-0", "non')  # torn
+    open(path, "wb").write(b"\n".join(raw) + b"\n")
+    states = replay_edges(path)
+    assert states[0] == {"nonce": 5, "quarantined": None}
+    assert states[1]["quarantined"] == "partial_timeout"
+
+
 def test_iter_jsonl_missing_file_and_non_objects(tmp_path):
     assert list(iter_jsonl(str(tmp_path / "absent.jsonl"))) == []
     p = tmp_path / "mixed.jsonl"

@@ -1,0 +1,460 @@
+"""2-tier edge -> root fan-in: the canonical wire, fold-algebra order
+invariance, the root's zero-trust chain, and in-process tree ==
+sequential bit-parity over real HTTP.
+
+The acceptance surface of the hierarchical topology (docs/SERVING.md):
+partials survive a JSON trip bit-exactly with lossless integer
+narrowing, every merge-tag fold is invariant to how the population is
+partitioned, a forged or replayed submission never reaches the fold, and
+a tree of edge processes reproduces the flat sequential aggregate
+byte-for-byte.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu.ops import shardctx
+from byzantine_aircomp_tpu.serve.edge import (
+    EdgeClient,
+    RoundRestart,
+    TopologyConfig,
+    run_edge,
+    sign_envelope,
+)
+from byzantine_aircomp_tpu.serve.root import RootServer, RootState
+
+# ------------------------------------------------------- wire roundtrip
+
+
+def test_wire_roundtrip_bit_exact():
+    """Narrowed, negative, empty, 0-d, bool and float leaves all survive
+    a JSON trip with their logical dtype, shape, and bytes intact."""
+    leaves = [
+        np.arange(300, dtype=np.int32),            # > uint8 range
+        np.array([-3, 250], dtype=np.int32),       # needs int16
+        np.array([], dtype=np.float32),            # empty
+        np.asarray(7, dtype=np.int32),             # 0-d scalar
+        np.array([True, False, True]),             # bool -> uint8 wire
+        np.array([1.5, -0.0, np.pi], dtype=np.float32),
+    ]
+    tags = ["sum"] * len(leaves)
+    wire = json.loads(json.dumps(shardctx.partial_to_wire(leaves, tags)))
+    back, tags2 = shardctx.partial_from_wire(wire)
+    assert tags2 == tags
+    for a, b in zip(leaves, back):
+        assert b.dtype == a.dtype and b.shape == a.shape
+        assert b.tobytes() == a.tobytes()
+    back[0][0] = 99  # decoded leaves are owned, writable arrays
+
+
+def test_wire_narrows_integers_losslessly():
+    small = shardctx.encode_leaf(np.arange(4, dtype=np.int64))
+    assert np.dtype(small["wdtype"]).itemsize == 1
+    # sign votes range over [-k, k]; +128 overflows int8, so int16
+    votes = shardctx.encode_leaf(np.array([-128, 128], dtype=np.int32))
+    assert np.dtype(votes["wdtype"]).itemsize == 2
+    floats = shardctx.encode_leaf(np.ones(3, np.float32))
+    assert floats["wdtype"] == floats["dtype"]  # floats ship verbatim
+
+
+def test_wire_version_and_arity_guards():
+    wire = shardctx.partial_to_wire([np.zeros(3, np.int32)], ["sum"])
+    with pytest.raises(ValueError, match="wire version"):
+        shardctx.partial_from_wire({**wire, "wire": 99})
+    with pytest.raises(ValueError, match="arity"):
+        shardctx.partial_from_wire({**wire, "tags": ["sum", "sum"]})
+    with pytest.raises(ValueError):
+        shardctx.partial_from_wire("not a dict")
+
+
+def test_wire_is_canonical():
+    """Bit-identical arrays produce byte-identical wire JSON — the
+    property the root's consensus byte-compare and HMAC rest on."""
+    a = np.linspace(-1.0, 1.0, 32, dtype=np.float32)
+    one = json.dumps(shardctx.partial_to_wire([a], ["sum"]),
+                     sort_keys=True)
+    two = json.dumps(shardctx.partial_to_wire([a.copy()], ["sum"]),
+                     sort_keys=True)
+    assert one == two
+
+
+# --------------------------------- fold algebra: partition invariance
+
+
+def _random_partition(rng, n, max_groups=8):
+    """Non-empty contiguous groups of random count and sizes."""
+    n_groups = int(rng.integers(1, min(max_groups, n) + 1))
+    cuts = np.sort(rng.choice(np.arange(1, n), size=n_groups - 1,
+                              replace=False)) if n_groups > 1 else []
+    return np.split(np.arange(n), cuts)
+
+
+def test_integer_fold_tags_are_partition_and_order_invariant():
+    """Property (randomized partition SIZES and fold ORDERS): integer
+    ``sum``/``min``/``max`` partials fold to the same bits as the flat
+    reduction no matter how the rows are grouped or the groups are
+    ordered — the invariant that lets tree == mesh == sequential hold
+    for rank counts, histograms, finite counts, and sign-vote planes."""
+    rng = np.random.default_rng(2021)
+    for _ in range(15):
+        n, d = int(rng.integers(2, 40)), int(rng.integers(1, 6))
+        rows = rng.integers(-(2**30), 2**30, size=(n, d)).astype(np.int32)
+        flat = {
+            "sum": rows.sum(axis=0, dtype=np.int32),
+            "min": rows.min(axis=0),
+            "max": rows.max(axis=0),
+        }
+        groups = _random_partition(rng, n)
+        partials = {
+            "sum": [rows[g].sum(axis=0, dtype=np.int32) for g in groups],
+            "min": [rows[g].min(axis=0) for g in groups],
+            "max": [rows[g].max(axis=0) for g in groups],
+        }
+        order = rng.permutation(len(groups))
+        for tag, parts in partials.items():
+            stacked = np.stack([parts[i] for i in order])
+            (out,) = shardctx.fold_partials(
+                (stacked,), (tag,), len(groups)
+            )
+            assert np.asarray(out).astype(np.int32).tobytes() == \
+                flat[tag].tobytes(), (tag, groups)
+
+
+def test_float_fold_is_deterministic_left_fold():
+    """Float ``sum`` partials are association-sensitive, so the wire
+    contract is weaker but exact: the fold is the canonical LEFT fold in
+    shard order — deterministic, and bit-equal to the explicit
+    reduction ``SeqShardCtx`` defines."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    parts = rng.standard_normal((5, 16)).astype(np.float32)
+    (out,) = shardctx.fold_partials((parts,), ("sum",), 5)
+    ref = jnp.asarray(parts[0])
+    for p in range(1, 5):
+        ref = jnp.add(ref, parts[p])
+    assert np.asarray(out).tobytes() == np.asarray(ref).tobytes()
+    (again,) = shardctx.fold_partials((parts.copy(),), ("sum",), 5)
+    assert np.asarray(again).tobytes() == np.asarray(out).tobytes()
+
+
+def test_stack_tag_passes_partials_through():
+    parts = np.arange(12, dtype=np.int32).reshape(3, 4)
+    (out,) = shardctx.fold_partials((parts,), ("stack",), 3)
+    assert np.asarray(out).tobytes() == parts.tobytes()
+
+
+# ------------------------------------------------- topology config
+
+
+def test_topology_config_validates_and_loads(tmp_path):
+    keys = {0: "aa" * 32, 1: "bb" * 32}
+    cfg = TopologyConfig(edges=2, k=8, d=4, cohort=4, rounds=1, keys=keys)
+    assert cfg.n_chunks == 2 and cfg.chunks_per_edge == 1
+    assert cfg.rows_per_edge == 4
+    with pytest.raises(ValueError, match="cohort"):
+        TopologyConfig(edges=2, k=9, d=4, cohort=4, rounds=1, keys=keys)
+    with pytest.raises(ValueError, match="edges"):
+        TopologyConfig(edges=3, k=8, d=4, cohort=4, rounds=1,
+                       keys={e: "aa" * 32 for e in range(3)})
+    with pytest.raises(ValueError, match="key"):
+        TopologyConfig(edges=2, k=8, d=4, cohort=4, rounds=1,
+                       keys={0: "aa" * 32})
+    path = tmp_path / "topo.json"
+    path.write_text(json.dumps({
+        "edges": 2, "k": 8, "d": 4, "cohort": 4, "rounds": 1,
+        "sign_bits": 1, "keys": {"0": "aa" * 32, "1": "bb" * 32},
+    }))
+    loaded = TopologyConfig.load(str(path))
+    assert loaded.keys[1] == "bb" * 32  # JSON string keys int-coerced
+    assert loaded.result_names == ["signvote"]
+
+
+# ----------------------------------------------- zero-trust root state
+
+
+def _topo(**over):
+    base = dict(
+        edges=2, k=8, d=4, cohort=4, rounds=2, aggs=[], sign_bits=1,
+        partial_timeout=5.0,
+        keys={0: "aa" * 32, 1: "bb" * 32},
+    )
+    base.update(over)
+    return TopologyConfig(**base)
+
+
+def _envelope(cfg, edge, nonce, seq=0, rnd=0, epoch=0, leaves=None,
+              tags=None, meta=None, key=None, mac=None) -> bytes:
+    if leaves is None:
+        leaves = [np.zeros(cfg.d, np.int32), np.asarray(4, np.int32)]
+    body = {
+        "op": "partial", "round": rnd, "epoch": epoch, "seq": seq,
+        "meta": meta or {},
+        **shardctx.partial_to_wire(
+            leaves, tags or ("sum",) * len(leaves)
+        ),
+        "edge": edge, "nonce": nonce,
+    }
+    body["mac"] = mac or sign_envelope(key or cfg.keys[edge], body)
+    return json.dumps(body).encode()
+
+
+def test_root_rejects_forged_mac_before_any_state_change():
+    cfg = _topo()
+    st = RootState(cfg)
+    status, resp = st.submit_partial(_envelope(cfg, 0, 1, mac="00" * 32))
+    assert status == 401 and resp["error"] == "bad_mac"
+    # signature under the WRONG key is just as forged
+    status, resp = st.submit_partial(_envelope(cfg, 0, 2, key="cc" * 32))
+    assert status == 401 and resp["error"] == "bad_mac"
+    # the forgery cost strikes but did NOT evict the claimed edge, did
+    # not record a phase, and did not consume a nonce
+    assert 0 in st.live and not st.quarantined
+    assert not st.phases and st.nonces[0] == 0
+    assert st.strikes[0] == 2
+    status, resp = st.submit_partial(_envelope(cfg, 7, 1, key="cc" * 32))
+    assert status == 401 and resp["error"] == "unknown edge"
+
+
+def test_root_replay_rejected_journaled_and_quarantined(tmp_path):
+    from byzantine_aircomp_tpu.serve import journal as journal_lib
+    from byzantine_aircomp_tpu.utils.io import iter_jsonl
+
+    cfg = _topo()
+    st = RootState(cfg, obs_dir=str(tmp_path))
+    captured = _envelope(cfg, 0, 1)
+    assert st.submit_partial(captured)[0] == 200
+    status, resp = st.submit_partial(captured)  # byte-for-byte replay
+    assert status == 409 and resp["error"] == "replay"
+    assert st.quarantined == {0: "replayed_nonce"}
+    assert st.epoch == 1  # survivors must restart the round
+    # a fresh, validly signed submission from the contained edge: 410
+    status, resp = st.submit_partial(_envelope(cfg, 0, 2, epoch=1))
+    assert status == 410 and resp["error"] == "replayed_nonce"
+    st.close()
+    ops = [r["op"] for r in iter_jsonl(
+        str(tmp_path / journal_lib.ROOT_JOURNAL_NAME)
+    )]
+    assert "replay_rejected" in ops and "edge_quarantined" in ops
+    # the containment replays into a restarted root before it serves
+    st2 = RootState(cfg, obs_dir=str(tmp_path))
+    assert st2.quarantined == {0: "replayed_nonce"}
+    assert st2.live == {1}
+    st2.close()
+
+
+def test_root_nonce_hwm_survives_restart(tmp_path):
+    """The per-round journal records the accepted-nonce high-water mark;
+    a restarted root still rejects submissions at or below it."""
+    from byzantine_aircomp_tpu.serve import journal as journal_lib
+
+    jr = journal_lib.RunJournal(
+        str(tmp_path / journal_lib.ROOT_JOURNAL_NAME)
+    )
+    jr.append("partial", "edge-1", round=0, nonce=7)
+    jr.close()
+    cfg = _topo()
+    st = RootState(cfg, obs_dir=str(tmp_path))
+    assert st.nonces[1] == 7
+    status, resp = st.submit_partial(_envelope(cfg, 1, 7))
+    assert status == 409 and resp["error"] == "replay"
+    st.close()
+
+
+def test_replay_edges_folds_journal(tmp_path):
+    from byzantine_aircomp_tpu.serve.journal import RunJournal, replay_edges
+
+    path = str(tmp_path / "rj.jsonl")
+    jr = RunJournal(path)
+    jr.append("partial", "edge-0", round=0, nonce=3)
+    jr.append("partial", "edge-0", round=1, nonce=9)
+    jr.append("replay_rejected", "edge-2", reason="replay", nonce=4)
+    jr.append("edge_quarantined", "edge-2", reason="replayed_nonce")
+    jr.append("partial", "not-an-edge", nonce=99)  # foreign run ignored
+    jr.close()
+    states = replay_edges(path)
+    assert states[0] == {"nonce": 9, "quarantined": None}
+    assert states[2] == {"nonce": 4, "quarantined": "replayed_nonce"}
+    assert set(states) == {0, 2}
+
+
+def test_root_partial_timeout_quarantines_and_bumps_epoch():
+    clock = [0.0]
+    cfg = _topo(partial_timeout=5.0)
+    st = RootState(cfg, now_fn=lambda: clock[0])
+    assert st.submit_partial(_envelope(cfg, 0, 1))[0] == 200
+    status, _ = st.get_fold(0, 0, 0, 0)
+    assert status == 202  # pending on edge 1
+    clock[0] = 6.0
+    st.deadline_check()
+    assert st.quarantined == {1: "partial_timeout"}
+    assert st.epoch == 1
+    # the survivor's stale-epoch poll tells it to restart the round
+    status, resp = st.get_fold(0, 0, 0, 0)
+    assert status == 409 and resp["error"] == "stale_epoch"
+    assert resp["epoch"] == 1
+    # re-run over the surviving set: a single-edge fold is immediate
+    leaves = [np.arange(cfg.d, dtype=np.int32), np.asarray(4, np.int32)]
+    assert st.submit_partial(
+        _envelope(cfg, 0, 2, epoch=1, leaves=leaves)
+    )[0] == 200
+    status, wire = st.get_fold(0, 0, 1, 0)
+    assert status == 200
+    folded, _ = shardctx.partial_from_wire(wire)
+    assert folded[0].tobytes() == leaves[0].tobytes()
+    st.close()
+
+
+def test_root_bad_payloads_quarantine_the_sender():
+    cfg = _topo(edges=3, k=12,
+                keys={e: f"{e:02d}" * 32 for e in range(3)})
+    st = RootState(cfg)
+    # wire-version skew: authenticated but undecodable
+    body = json.loads(_envelope(cfg, 0, 1).decode())
+    body["wire"] = 99
+    del body["mac"]
+    body["mac"] = sign_envelope(cfg.keys[0], body)
+    status, resp = st.submit_partial(json.dumps(body).encode())
+    assert status == 422 and st.quarantined[0] == "bad_payload"
+    # a non-finite float partial would poison every downstream fold
+    status, resp = st.submit_partial(_envelope(
+        cfg, 1, 1, epoch=st.epoch,
+        leaves=[np.array([np.nan], np.float32)], tags=("sum",),
+    ))
+    assert status == 422 and resp["error"] == "nonfinite partial"
+    assert st.quarantined[1] == "nonfinite_partial"
+    st.close()
+
+
+def test_root_consensus_quarantines_dissenter_without_epoch_bump():
+    cfg = _topo(edges=3, k=12,
+                keys={e: f"{e:02d}" * 32 for e in range(3)})
+    st = RootState(cfg)
+    honest = [np.arange(cfg.d, dtype=np.int32)]
+    lying = [np.arange(cfg.d, dtype=np.int32) + 1]
+    meta = {"label": "results", "names": ["signvote"]}
+    for edge, leaves in ((0, honest), (1, honest), (2, lying)):
+        status, _ = st.submit_partial(_envelope(
+            cfg, edge, 1, leaves=leaves, tags=("same",), meta=meta,
+        ))
+        assert status == 200
+    assert st.quarantined == {2: "result_mismatch"}
+    assert st.epoch == 0  # the fold stood on the majority; no restart
+    status, wire = st.get_fold(0, 0, 0, 0)
+    assert status == 200
+    folded, _ = shardctx.partial_from_wire(wire)
+    assert folded[0].tobytes() == honest[0].tobytes()
+    res = st.results()
+    got = shardctx.decode_leaf(res["rounds"]["0"]["results"]["signvote"])
+    assert got.tobytes() == honest[0].tobytes()
+    st.close()
+
+
+def test_root_phase_schema_disagreement_is_contained():
+    cfg = _topo()
+    st = RootState(cfg)
+    assert st.submit_partial(_envelope(cfg, 0, 1))[0] == 200
+    status, resp = st.submit_partial(_envelope(
+        cfg, 1, 1, leaves=[np.zeros(cfg.d + 1, np.int32),
+                           np.asarray(4, np.int32)],
+    ))
+    assert status == 422 and "schema" in resp["error"]
+    assert st.quarantined[1] == "bad_payload"
+    st.close()
+
+
+# ------------------------------------- in-process tree == sequential
+
+
+@pytest.fixture
+def sync_dispatch():
+    """In-process multi-edge needs synchronous CPU dispatch: with async
+    dispatch XLA runs host callbacks on a shared pool thread, and one
+    edge's blocked exchange starves every other edge's callbacks."""
+    import jax
+
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+    yield
+    jax.config.update("jax_cpu_enable_async_dispatch", True)
+
+
+def test_tree_matches_sequential_over_http(tmp_path, sync_dispatch):
+    """Two edge threads against a real RootServer on an ephemeral port:
+    the folded round results must be BIT-identical to the flat
+    single-process ``SeqShardCtx`` aggregate and the whole-stack packed
+    sign vote."""
+    import jax
+    import jax.numpy as jnp
+
+    from byzantine_aircomp_tpu.ops import aggregators
+    from byzantine_aircomp_tpu.serve.edge import round_stack
+
+    cfg = _topo(
+        edges=2, k=8, d=16, cohort=4, rounds=1, aggs=["mean"],
+        sign_bits=1, seed=11, partial_timeout=120.0,
+        keys={0: "aa" * 32, 1: "bb" * 32},
+    )
+    with RootServer(cfg, obs_dir=str(tmp_path), host="127.0.0.1") as srv:
+        url = f"http://127.0.0.1:{srv.port}"
+        summaries = {}
+
+        def run(e):
+            summaries[e] = run_edge(cfg, e, url)
+
+        threads = [
+            threading.Thread(target=run, args=(e,)) for e in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        res = srv.state.results()
+    for e in range(2):
+        assert summaries[e]["status"] == "completed", summaries[e]
+        assert summaries[e]["steady_state_ok"], summaries[e]
+        assert summaries[e]["lowerings"] == {"edge_round_fn": 1}
+    assert res["fold_lowerings"] == res["fold_signatures"]
+    assert not res["quarantined"]
+    rr = res["rounds"]["0"]
+    assert rr["completed"] and not rr["degraded"]
+
+    stack = round_stack(cfg.seed, 0, cfg.k, cfg.d)
+    ctx = shardctx.SeqShardCtx(cfg.edges)
+
+    def rebuild(c):
+        return jax.lax.dynamic_slice(
+            stack, (c * cfg.cohort, 0), (cfg.cohort, cfg.d)
+        )
+
+    sa, sf, nf = aggregators.stream_stats(rebuild, cfg.n_chunks, cfg.d,
+                                          ctx)
+    ref_mean = np.asarray(aggregators.stream_aggregate(
+        "mean", rebuild, k=cfg.k, d=cfg.d, n_chunks=cfg.n_chunks,
+        degraded=False, sum_all=sa, sum_finite=sf, n_finite=nf, ctx=ctx,
+    ))
+    words, kv = aggregators.pack_signs(stack, jnp.zeros(cfg.d,
+                                                        jnp.float32))
+    ref_vote = np.asarray(
+        (2 * aggregators.packed_sign_votes(words, cfg.d) - kv)
+        .astype(jnp.int32)
+    )
+    got_mean = shardctx.decode_leaf(rr["results"]["mean"])
+    got_vote = shardctx.decode_leaf(rr["results"]["signvote"])
+    assert got_mean.tobytes() == ref_mean.tobytes()
+    assert got_vote.tobytes() == ref_vote.tobytes()
+
+
+def test_edge_client_classifies_protocol_answers():
+    client = EdgeClient("http://127.0.0.1:1", 0, "aa" * 32)
+    with pytest.raises(RoundRestart) as exc:
+        client._raise_for(409, {"error": "stale_epoch", "epoch": 3})
+    assert exc.value.epoch == 3
+    from byzantine_aircomp_tpu.serve.edge import EdgeQuarantined
+
+    with pytest.raises(EdgeQuarantined):
+        client._raise_for(410, {"error": "replayed_nonce"})
+    with pytest.raises(RuntimeError, match="500"):
+        client._raise_for(500, {"error": "boom"})

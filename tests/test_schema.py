@@ -53,6 +53,11 @@ GOLDEN = {
     # journal_replay (lane quarantine, watchdog requeue, journal replay
     # adoption — serve/runs.py, serve/journal.py, docs/RUNBOOK.md)
     6: "dc708831ebabb12d",
+    # v7 added the 2-tier fan-in kinds edge_partial / edge_reject /
+    # edge_quarantine / edge_round (zero-trust submissions, replay
+    # containment, per-round root ingress — serve/edge.py,
+    # serve/root.py, docs/SERVING.md)
+    7: "59bc79ee93f254c9",
 }
 
 

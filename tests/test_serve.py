@@ -540,3 +540,86 @@ def test_sweep_batched_matches_eager(synthetic_mnist):
     for key, cell in eager.items():
         for col in ("val_acc", "val_loss", "val_acc_std"):
             assert cell[col] == batched[key][col], (key, col)
+
+
+# ------------------------------------------------- bearer auth
+
+
+def test_auth_token_guards_mutating_endpoints(tmp_path):
+    """``--auth-token`` bearer auth: every mutating POST under /runs is
+    401 without the token; reads, /metrics and /healthz stay open so
+    scrapers and dashboards need no credentials.  Exporter-only start —
+    no scheduler — keeps the run queued and the test deterministic."""
+    from byzantine_aircomp_tpu.serve.server import ExperimentServer
+
+    tiny = dict(
+        dataset="mnist", honest_size=6, byz_size=0, rounds=2,
+        display_interval=2, batch_size=16, agg="mean", eval_train=False,
+    )
+    srv = ExperimentServer(
+        str(tmp_path / "root"), port=0, host="127.0.0.1",
+        auth_token="s3kr1t",
+    )
+    srv.exporter.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def req(method, path, body=None, token=None, raw_auth=None):
+            data = json.dumps(body).encode() if body is not None else None
+            headers = {}
+            if token is not None:
+                headers["Authorization"] = f"Bearer {token}"
+            if raw_auth is not None:
+                headers["Authorization"] = raw_auth
+            r = urllib.request.Request(
+                base + path, data=data, method=method, headers=headers
+            )
+            try:
+                with urllib.request.urlopen(r, timeout=30) as resp:
+                    return resp.status, json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as exc:
+                return exc.code, json.loads(exc.read() or b"{}")
+
+        s, err = req("POST", "/runs", {**tiny, "seed": 1})
+        assert s == 401 and err["error"] == "unauthorized"
+        assert req("POST", "/runs", {**tiny, "seed": 1},
+                   token="wrong")[0] == 401
+        # a non-Bearer scheme never matches
+        assert req("POST", "/runs", {**tiny, "seed": 1},
+                   raw_auth="Basic s3kr1t")[0] == 401
+        s, r1 = req("POST", "/runs", {**tiny, "seed": 1}, token="s3kr1t")
+        assert s == 201
+        rid = r1["run_id"]
+        assert req("POST", f"/runs/{rid}/cancel")[0] == 401
+        assert req("POST", f"/runs/{rid}/knobs",
+                   {"gamma": 0.5})[0] == 401
+        assert req("POST", f"/runs/{rid}/cancel", token="s3kr1t")[0] == 200
+        # reads and scrapes stay open
+        assert req("GET", "/runs")[0] == 200
+        assert req("GET", f"/runs/{rid}")[0] == 200
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as m:
+            assert m.status == 200
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as h:
+            assert h.status == 200
+    finally:
+        srv.exporter.close()
+        srv.manager.close()
+
+
+def test_no_auth_token_leaves_endpoints_open(tmp_path):
+    from byzantine_aircomp_tpu.serve.server import ExperimentServer
+
+    srv = ExperimentServer(str(tmp_path / "root"), port=0,
+                           host="127.0.0.1")
+    srv.exporter.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        tiny = dict(
+            dataset="mnist", honest_size=6, byz_size=0, rounds=2,
+            display_interval=2, batch_size=16, agg="mean",
+            eval_train=False,
+        )
+        assert _req(base, "POST", "/runs", {**tiny, "seed": 1})[0] == 201
+    finally:
+        srv.exporter.close()
+        srv.manager.close()
