@@ -149,7 +149,8 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
      "severity": "page", "absent": 0.0, "min_samples": 2},
     # 2-tier containment: ANY edge quarantined inside the window pages —
     # an evicted edge is lost capacity AND a possible compromise
-    # (replayed nonce, forged payload, result dissent); see RUNBOOK.md
+    # (bogus payload, result dissent, repeated authenticated
+    # violations); see RUNBOOK.md
     {"name": "edge_quarantine_rate",
      "metric": "aircomp_edge_quarantines_total",
      "window": 8, "reduce": "delta", "op": "ge", "value": 1,

@@ -73,10 +73,12 @@ from typing import Any, Dict, Optional
 # (one accepted, HMAC-verified wire partial — ``bytes`` is its raw
 # ingress size, the quantity the perf ledger's bytes/round row sums),
 # ``edge_reject`` (a zero-trust rejection: ``reason`` is bad_mac /
-# replay / the payload-check failures), ``edge_quarantine`` (an edge
-# contained — partial_timeout, replayed_nonce, bad_payload,
-# nonfinite_partial, result_mismatch), and ``edge_round`` (a round
-# closed over the live set; ``degraded`` marks a surviving-edge fold).
+# replay — attacker-producible, never contained — or bad_round /
+# bad_seq, authenticated violations that accrue strikes),
+# ``edge_quarantine`` (an edge contained — partial_timeout,
+# bad_payload, nonfinite_partial, result_mismatch, strike_limit), and
+# ``edge_round`` (a round closed over the live set; ``degraded`` marks
+# a surviving-edge fold).
 SCHEMA_VERSION = 7
 
 # round-event field -> reference pickled-record key it mirrors

@@ -114,6 +114,9 @@ class TopologyConfig:
     gm2_maxiter: int = 1000
     seed: int = 2021
     partial_timeout: float = 5.0
+    # authenticated protocol violations (validly signed, fresh-nonce
+    # envelopes the root still rejects) before the edge is quarantined;
+    # forgeries and replays never count — they are attacker-producible
     strike_limit: int = 3
     keys: Dict[int, str] = field(default_factory=dict)
 

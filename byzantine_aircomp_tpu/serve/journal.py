@@ -151,8 +151,12 @@ ROOT_JOURNAL_NAME = "root_journal.jsonl"
 #: per-round accepted-nonce high-water mark (written at round close, not
 #: per exchange — a round is ~100 exchanges and the HWM is all restart
 #: recovery needs), ``replay_rejected`` / ``forged_rejected`` record the
-#: zero-trust rejections with the offending nonce, ``edge_quarantined``
-#: the containment decision, and ``round_done`` the fleet-level close.
+#: zero-trust rejections with the offending nonce (a replay rejection
+#: also raises the HWM floor, so a captured submission stays dead across
+#: restarts without quarantining the edge it names), ``strike`` an
+#: authenticated protocol violation counting toward ``strike_limit``,
+#: ``edge_quarantined`` the containment decision, and ``round_done`` the
+#: fleet-level close.
 
 
 def replay_edges(

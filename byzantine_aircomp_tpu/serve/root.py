@@ -8,16 +8,32 @@ engine uses — so tree == mesh == sequential stays bit-identical — and
 hands the fold back to polling edges.  Zero-trust posture:
 
 * a forged MAC never reaches the fold: it is rejected before decode,
-  journaled (``forged_rejected``) and counted; repeated forgeries only
-  ever cost strikes — they can NOT quarantine the edge whose identity
-  they claim, or any attacker could evict the fleet edge by edge.
-* a replayed nonce under a VALID mac means the channel itself is
-  compromised (the key leaked or the edge is duplicated), so it is
-  rejected (409), journaled (``replay_rejected``) AND the edge is
-  quarantined immediately.
-* a partial that fails decode / finite / shape checks quarantines its
-  edge (``bad_payload`` / ``nonfinite_partial``) — the lane-eviction
-  pattern from the batch runner applied one level up.
+  journaled (``forged_rejected``) and counted per claimed identity —
+  it can NOT quarantine or strike the edge whose identity it claims,
+  or any attacker could evict the fleet edge by edge.
+* a replayed nonce under a VALID mac is rejected (409) and journaled
+  (``replay_rejected``), but does NOT quarantine the edge either: the
+  protocol runs over plain HTTP, so any on-path observer can capture
+  and re-POST a legitimate submission — containment here would turn
+  passive capture into permanent fleet eviction.  The nonce
+  high-water mark already makes the replay inert; distinguishing a
+  hostile channel from a compromised edge is an operator call
+  (docs/RUNBOOK.md).
+* an AUTHENTICATED protocol violation — a fresh, validly signed
+  envelope carrying a malformed seq or an out-of-range round, which
+  only the keyholder could have produced (every verified envelope
+  burns its nonce even when later rejected, so a capture cannot be
+  replayed to inflate the count) — costs a strike; at
+  ``strike_limit`` strikes the edge is quarantined (``strike_limit``).
+* a partial that fails decode / finite checks quarantines its edge
+  (``bad_payload`` / ``nonfinite_partial``) — the lane-eviction
+  pattern from the batch runner applied one level up.  The phase
+  schema (tags/shapes/meta) is decided by NO single submitter:
+  submissions buffer until every live edge has reported, the majority
+  schema wins (a tie resolves to the first edge in shard order — the
+  result-consensus rule), and the dissenting minority is quarantined
+  (``bad_payload``) — a Byzantine edge that races a bogus schema in
+  first cannot evict the honest fleet one epoch at a time.
 * a missing partial past ``partial_timeout`` quarantines the silent
   edges and bumps the round's EPOCH: survivors see ``stale_epoch`` on
   their next request, re-read the live set, and re-run the round in
@@ -103,7 +119,12 @@ class RootState:
         self.live = set(range(cfg.edges))
         self.quarantined: Dict[int, str] = {}
         self.nonces: Dict[int, int] = {e: 0 for e in range(cfg.edges)}
+        # strikes: authenticated protocol violations (strike_limit
+        # enforced); forged/replays: attacker-producible rejections,
+        # counted per claimed identity for observability ONLY
         self.strikes: Dict[int, int] = {}
+        self.forged: Dict[int, int] = {}
+        self.replays: Dict[int, int] = {}
         self.epoch = 0
         # (round, epoch, seq) -> phase dict
         self.phases: Dict[Tuple[int, int, int], Dict[str, Any]] = {}
@@ -164,19 +185,41 @@ class RootState:
 
     def _reject(self, edge: int, reason: str, status: int,
                 journal_op: Optional[str] = None, **extra: Any) -> Reject:
-        self.strikes[edge] = self.strikes.get(edge, 0) + 1
+        """An attacker-producible rejection (forgery, replay): journaled
+        and counted, but never a strike — anything an observer can
+        trigger must carry no consequence for the claimed edge."""
         if journal_op:
             self._journal(journal_op, edge, reason=reason, **extra)
         self._emit("edge_reject", edge=edge, reason=reason)
         return Reject(status, error=reason, **extra)
+
+    def _strike(self, edge: int, reason: str, status: int,
+                nonce: Optional[int] = None, **extra: Any) -> Reject:
+        """An authenticated violation: the envelope carried a fresh,
+        valid MAC+nonce, so only the keyholder produced it.  These are
+        attributable, so they accrue toward ``cfg.strike_limit``.  The
+        burned nonce rides the journal entry so the HWM floor survives
+        a restart — a captured violation cannot be replayed to strike
+        twice."""
+        self.strikes[edge] = self.strikes.get(edge, 0) + 1
+        self._journal("strike", edge, reason=reason, nonce=nonce,
+                      strikes=self.strikes[edge])
+        self._emit("edge_reject", edge=edge, reason=reason)
+        exc = Reject(status, error=reason, **extra)
+        if self.strikes[edge] >= self.cfg.strike_limit:
+            self._quarantine(edge, "strike_limit")
+        return exc
 
     # ------------------------------------------------------ verification
 
     def _verify(self, body: Any, op: str) -> int:
         """The zero-trust chain; returns the verified edge id or raises
         :class:`Reject`.  Order matters: identity before authenticity,
-        authenticity before ANY stateful reaction, replay/epoch before
-        decode — an unauthenticated byte never changes fold state."""
+        authenticity before ANY stateful reaction, replay before decode
+        — an unauthenticated byte never changes fold state.  A verified
+        nonce is burned IMMEDIATELY, before the epoch/round checks, so
+        a later-rejected envelope cannot be captured and replayed (the
+        property the strike accounting relies on)."""
         if not isinstance(body, dict) or body.get("op") != op:
             raise Reject(400, error=f"body must be a signed {op!r} envelope")
         edge = body.get("edge")
@@ -185,6 +228,7 @@ class RootState:
         mac = body.get("mac")
         want = sign_envelope(self.cfg.keys[edge], body)
         if not (isinstance(mac, str) and hmac_lib.compare_digest(mac, want)):
+            self.forged[edge] = self.forged.get(edge, 0) + 1
             raise self._reject(
                 edge, "bad_mac", 401, journal_op="forged_rejected",
                 nonce=body.get("nonce"),
@@ -194,20 +238,25 @@ class RootState:
             raise Reject(410, error=self.quarantined[edge])
         nonce = body.get("nonce")
         if not isinstance(nonce, int) or nonce <= self.nonces[edge]:
-            # a VALID mac with a reused nonce is a captured-and-replayed
-            # submission: the channel is compromised, contain the edge
-            exc = self._reject(
+            # a VALID mac with a reused nonce: either the channel echoed
+            # (an on-path observer replaying a capture) or the edge is
+            # duplicated.  The root cannot tell which, and the first is
+            # attacker-triggerable, so the replay is rejected and
+            # journaled (the HWM keeps it inert across restarts) but the
+            # edge is NOT quarantined — otherwise one passive capture
+            # per edge would durably evict the whole fleet.
+            self.replays[edge] = self.replays.get(edge, 0) + 1
+            raise self._reject(
                 edge, "replay", 409, journal_op="replay_rejected",
                 nonce=nonce,
             )
-            self._quarantine(edge, "replayed_nonce")
-            raise exc
+        self.nonces[edge] = nonce
         if body.get("epoch") != self.epoch:
             raise Reject(409, error="stale_epoch", epoch=self.epoch)
         rnd = body.get("round")
         if not isinstance(rnd, int) or not 0 <= rnd < self.cfg.rounds:
-            raise Reject(400, error=f"round {rnd!r} out of range")
-        self.nonces[edge] = nonce
+            raise self._strike(edge, "bad_round", 400, nonce=nonce,
+                               round=rnd)
         return edge
 
     # ------------------------------------------------------------- folds
@@ -314,12 +363,17 @@ class RootState:
                 edge = self._verify(body, "partial")
                 seq = body.get("seq")
                 if not isinstance(seq, int) or seq < 0:
-                    raise Reject(400, error=f"bad seq {seq!r}")
+                    raise self._strike(edge, "bad_seq", 400,
+                                       nonce=body["nonce"], seq=repr(seq))
                 try:
+                    # malformed leaf dicts raise KeyError (missing
+                    # wdtype/data/shape) or TypeError (bad shape/dtype
+                    # entries), not only ValueError — all three are the
+                    # same authenticated-hostile payload
                     leaves, tags = shardctx.partial_from_wire(body)
-                except ValueError as exc:
+                except (ValueError, KeyError, TypeError) as exc:
                     self._quarantine(edge, "bad_payload")
-                    raise Reject(422, error=f"bad payload: {exc}")
+                    raise Reject(422, error=f"bad payload: {exc!r}")
                 for x in leaves:
                     if x.dtype.kind == "f" and not np.isfinite(x).all():
                         self._quarantine(edge, "nonfinite_partial")
@@ -327,20 +381,20 @@ class RootState:
                 rnd = body["round"]
                 key = (rnd, self.epoch, seq)
                 phase = self.phases.setdefault(key, {
-                    "subs": {}, "tags": tags, "meta": body.get("meta"),
+                    "subs": {}, "tags": None, "meta": None,
                     "first_ts": self.now(), "folded": None,
-                    "shapes": [(x.shape, x.dtype.str) for x in leaves],
                 })
-                if (
-                    list(tags) != list(phase["tags"])
-                    or [(x.shape, x.dtype.str) for x in leaves]
-                    != phase["shapes"]
-                ):
-                    self._quarantine(edge, "bad_payload")
-                    raise Reject(
-                        422, error="partial disagrees with phase schema"
-                    )
-                phase["subs"][edge] = leaves
+                if phase["folded"] is not None:
+                    # the fold stands: a fresh-nonce resubmission can
+                    # neither re-open the vote nor refold the phase
+                    return 200, {"ok": True, "seq": seq, "folded": True}
+                phase["subs"][edge] = {
+                    "leaves": leaves,
+                    "tags": list(tags),
+                    "shapes": [(list(x.shape), x.dtype.str)
+                               for x in leaves],
+                    "meta": body.get("meta"),
+                }
                 rst = self._round(rnd)
                 rst["ingress"] += len(raw)
                 self._emit(
@@ -348,10 +402,44 @@ class RootState:
                     bytes=len(raw),
                 )
                 if self.live <= set(phase["subs"]):
-                    self._fold(key, phase)
+                    self._resolve(key, phase, submitter=edge)
                 return 200, {"ok": True, "seq": seq}
             except Reject as exc:
                 return exc.status, exc.payload
+
+    def _resolve(self, key: Tuple[int, int, int], phase: Dict[str, Any],
+                 submitter: int) -> None:
+        """Every live edge has reported: decide the phase schema by
+        majority vote — NO single submitter is trusted with it — then
+        fold.  The minority is quarantined (``bad_payload``), which
+        bumps the epoch so survivors re-run the round; a tie resolves
+        to the first edge in shard order (the result-consensus rule)."""
+        subs = phase["subs"]
+        order = sorted(subs)
+        schemas = {
+            e: json.dumps(
+                [subs[e]["tags"], subs[e]["shapes"], subs[e]["meta"]],
+                sort_keys=True,
+            )
+            for e in order
+        }
+        votes = Counter(schemas.values())
+        best = max(votes.values())
+        win_edge = next(e for e in order if votes[schemas[e]] == best)
+        losers = [e for e in order if schemas[e] != schemas[win_edge]]
+        if losers:
+            for e in losers:
+                self._quarantine(e, "bad_payload")
+            if submitter in losers:
+                raise Reject(
+                    422, error="partial disagrees with phase schema quorum"
+                )
+            return
+        winner = subs[win_edge]
+        phase["tags"] = winner["tags"]
+        phase["meta"] = winner["meta"]
+        phase["subs"] = {e: subs[e]["leaves"] for e in order}
+        self._fold(key, phase)
 
     def get_fold(self, rnd: int, seq: int, epoch: int,
                  edge: Optional[int]) -> Tuple[int, Dict[str, Any]]:
@@ -441,6 +529,8 @@ class RootState:
                 "live": sorted(self.live),
                 "quarantined": dict(self.quarantined),
                 "strikes": dict(self.strikes),
+                "forged": dict(self.forged),
+                "replays": dict(self.replays),
                 "rounds": rounds,
                 "fold_lowerings": self.detector.count("root_fold_fn"),
                 "fold_signatures": len(self._fold_sigs),

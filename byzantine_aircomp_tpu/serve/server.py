@@ -142,14 +142,18 @@ class ExperimentServer:
         """The exporter's extra-route hook; ``None`` falls through to the
         built-in ``/metrics``/``/healthz`` handling."""
         path = path.split("?", 1)[0]
+        # normalize ONCE, before the auth gate: the dispatcher drops
+        # empty segments, so gating on the raw path would let
+        # ``POST //runs`` skip auth yet still dispatch
+        parts = [p for p in path.split("/") if p]
         if (
             method == "POST"
-            and path.split("/", 2)[1:2] == ["runs"]
+            and parts[:1] == ["runs"]
             and not self._authorized(headers or {})
         ):
             return self._json(401, {"error": "unauthorized"})
         try:
-            return self._dispatch(method, path, body)
+            return self._dispatch(method, parts, body)
         except KeyError as exc:
             return self._json(404, {"error": str(exc).strip("'\"")})
         except QueueFull as exc:  # backpressure, not a client error
@@ -162,9 +166,8 @@ class ExperimentServer:
             )
 
     def _dispatch(
-        self, method: str, path: str, body: bytes
+        self, method: str, parts: list, body: bytes
     ) -> Optional[Tuple[int, str, bytes]]:
-        parts = [p for p in path.split("/") if p]
         if not parts or parts[0] != "runs":
             return None
         mgr = self.manager

@@ -210,16 +210,24 @@ def test_root_rejects_forged_mac_before_any_state_change():
     # signature under the WRONG key is just as forged
     status, resp = st.submit_partial(_envelope(cfg, 0, 2, key="cc" * 32))
     assert status == 401 and resp["error"] == "bad_mac"
-    # the forgery cost strikes but did NOT evict the claimed edge, did
-    # not record a phase, and did not consume a nonce
+    # the forgery was counted against the claimed identity but did NOT
+    # evict or strike the claimed edge, record a phase, or consume a
+    # nonce — anything an attacker can produce must cost the edge
+    # nothing enforceable
     assert 0 in st.live and not st.quarantined
     assert not st.phases and st.nonces[0] == 0
-    assert st.strikes[0] == 2
+    assert st.forged[0] == 2 and not st.strikes
     status, resp = st.submit_partial(_envelope(cfg, 7, 1, key="cc" * 32))
     assert status == 401 and resp["error"] == "unknown edge"
 
 
-def test_root_replay_rejected_journaled_and_quarantined(tmp_path):
+def test_root_replay_rejected_journaled_not_quarantined(tmp_path):
+    """A replayed nonce under a valid MAC is rejected and journaled but
+    must NOT quarantine the edge it names: over plain HTTP any on-path
+    observer can capture and re-POST a legitimate submission, so
+    containment here would turn passive sniffing into permanent fleet
+    eviction.  The journaled HWM keeps the capture dead across
+    restarts."""
     from byzantine_aircomp_tpu.serve import journal as journal_lib
     from byzantine_aircomp_tpu.utils.io import iter_jsonl
 
@@ -229,20 +237,24 @@ def test_root_replay_rejected_journaled_and_quarantined(tmp_path):
     assert st.submit_partial(captured)[0] == 200
     status, resp = st.submit_partial(captured)  # byte-for-byte replay
     assert status == 409 and resp["error"] == "replay"
-    assert st.quarantined == {0: "replayed_nonce"}
-    assert st.epoch == 1  # survivors must restart the round
-    # a fresh, validly signed submission from the contained edge: 410
-    status, resp = st.submit_partial(_envelope(cfg, 0, 2, epoch=1))
-    assert status == 410 and resp["error"] == "replayed_nonce"
+    assert not st.quarantined and 0 in st.live
+    assert st.epoch == 0  # no restart: the fleet keeps working
+    assert st.replays[0] == 1 and not st.strikes
+    # the edge itself is unaffected: its next fresh nonce is accepted
+    assert st.submit_partial(
+        _envelope(cfg, 0, 2, seq=1, tags=("sum", "sum"))
+    )[0] == 200
     st.close()
     ops = [r["op"] for r in iter_jsonl(
         str(tmp_path / journal_lib.ROOT_JOURNAL_NAME)
     )]
-    assert "replay_rejected" in ops and "edge_quarantined" in ops
-    # the containment replays into a restarted root before it serves
+    assert "replay_rejected" in ops and "edge_quarantined" not in ops
+    # the journaled rejection carries the nonce, so the HWM floor (and
+    # with it the replay protection) survives a root restart
     st2 = RootState(cfg, obs_dir=str(tmp_path))
-    assert st2.quarantined == {0: "replayed_nonce"}
-    assert st2.live == {1}
+    assert not st2.quarantined and st2.live == {0, 1}
+    status, resp = st2.submit_partial(captured)
+    assert status == 409 and resp["error"] == "replay"
     st2.close()
 
 
@@ -272,12 +284,12 @@ def test_replay_edges_folds_journal(tmp_path):
     jr.append("partial", "edge-0", round=0, nonce=3)
     jr.append("partial", "edge-0", round=1, nonce=9)
     jr.append("replay_rejected", "edge-2", reason="replay", nonce=4)
-    jr.append("edge_quarantined", "edge-2", reason="replayed_nonce")
+    jr.append("edge_quarantined", "edge-2", reason="bad_payload")
     jr.append("partial", "not-an-edge", nonce=99)  # foreign run ignored
     jr.close()
     states = replay_edges(path)
     assert states[0] == {"nonce": 9, "quarantined": None}
-    assert states[2] == {"nonce": 4, "quarantined": "replayed_nonce"}
+    assert states[2] == {"nonce": 4, "quarantined": "bad_payload"}
     assert set(states) == {0, 2}
 
 
@@ -326,6 +338,15 @@ def test_root_bad_payloads_quarantine_the_sender():
     ))
     assert status == 422 and resp["error"] == "nonfinite partial"
     assert st.quarantined[1] == "nonfinite_partial"
+    # a leaf dict missing its fields raises KeyError inside decode, not
+    # ValueError — still contained as bad_payload, never a 500
+    body = json.loads(_envelope(cfg, 2, 1).decode())
+    body["epoch"] = st.epoch
+    del body["leaves"][0]["wdtype"]
+    body.pop("mac")
+    body["mac"] = sign_envelope(cfg.keys[2], body)
+    status, resp = st.submit_partial(json.dumps(body).encode())
+    assert status == 422 and st.quarantined[2] == "bad_payload"
     st.close()
 
 
@@ -353,7 +374,32 @@ def test_root_consensus_quarantines_dissenter_without_epoch_bump():
     st.close()
 
 
-def test_root_phase_schema_disagreement_is_contained():
+def test_root_phase_schema_majority_outvotes_first_submitter():
+    """No first-submitter trust: a Byzantine edge that races a bogus
+    schema in FIRST is the one quarantined once every live edge has
+    reported and the majority vote resolves — it cannot evict honest
+    edges one per epoch by winning the race."""
+    cfg = _topo(edges=3, k=12,
+                keys={e: f"{e:02d}" * 32 for e in range(3)})
+    st = RootState(cfg)
+    bogus = [np.zeros(cfg.d + 1, np.int32), np.asarray(4, np.int32)]
+    assert st.submit_partial(_envelope(cfg, 0, 1, leaves=bogus))[0] == 200
+    assert st.submit_partial(_envelope(cfg, 1, 1))[0] == 200
+    # nothing folds (and nobody is evicted) until the fleet has voted
+    assert not st.quarantined
+    assert st.get_fold(0, 0, 0, None)[0] == 202
+    status, _ = st.submit_partial(_envelope(cfg, 2, 1))
+    assert status == 200
+    assert st.quarantined == {0: "bad_payload"}
+    assert st.live == {1, 2}
+    assert st.epoch == 1  # survivors re-run the round degraded
+    st.close()
+
+
+def test_root_phase_schema_minority_submitter_rejected():
+    """The completing submitter that loses the vote gets the 422; a
+    two-edge tie resolves to the first edge in shard order (the result-
+    consensus rule), so the dissenting later edge is the minority."""
     cfg = _topo()
     st = RootState(cfg)
     assert st.submit_partial(_envelope(cfg, 0, 1))[0] == 200
@@ -362,7 +408,45 @@ def test_root_phase_schema_disagreement_is_contained():
                            np.asarray(4, np.int32)],
     ))
     assert status == 422 and "schema" in resp["error"]
-    assert st.quarantined[1] == "bad_payload"
+    assert st.quarantined == {1: "bad_payload"}
+    assert 0 in st.live
+    st.close()
+
+
+def test_root_strike_limit_contains_authenticated_abuse():
+    """Validly signed, fresh-nonce envelopes the root still rejects can
+    only come from the keyholder, so they accrue strikes and the edge
+    is quarantined at ``strike_limit``.  Replaying a struck envelope
+    cannot inflate the count: its nonce is already burned."""
+    cfg = _topo(strike_limit=3)
+    st = RootState(cfg)
+    for n in (1, 2):
+        status, resp = st.submit_partial(_envelope(cfg, 0, n, rnd=99))
+        assert status == 400 and resp["error"] == "bad_round"
+        assert 0 in st.live
+    status, resp = st.submit_partial(_envelope(cfg, 0, 2, rnd=99))
+    assert status == 409 and resp["error"] == "replay"
+    assert st.strikes[0] == 2 and 0 in st.live
+    status, resp = st.submit_partial(_envelope(cfg, 0, 3, rnd=99))
+    assert status == 400
+    assert st.quarantined == {0: "strike_limit"}
+    st.close()
+
+
+def test_root_folded_phase_ignores_late_resubmission():
+    """Once a phase folds, a fresh-nonce resubmission can neither
+    re-open the schema vote nor refold the phase with different data."""
+    cfg = _topo()
+    st = RootState(cfg)
+    assert st.submit_partial(_envelope(cfg, 0, 1))[0] == 200
+    assert st.submit_partial(_envelope(cfg, 1, 1))[0] == 200
+    status, wire = st.get_fold(0, 0, 0, None)
+    assert status == 200
+    poison = [np.full(cfg.d, 9, np.int32), np.asarray(4, np.int32)]
+    status, resp = st.submit_partial(_envelope(cfg, 0, 2, leaves=poison))
+    assert status == 200 and resp.get("folded")
+    status2, wire2 = st.get_fold(0, 0, 0, None)
+    assert status2 == 200 and wire2 == wire
     st.close()
 
 
@@ -455,6 +539,6 @@ def test_edge_client_classifies_protocol_answers():
     from byzantine_aircomp_tpu.serve.edge import EdgeQuarantined
 
     with pytest.raises(EdgeQuarantined):
-        client._raise_for(410, {"error": "replayed_nonce"})
+        client._raise_for(410, {"error": "bad_payload"})
     with pytest.raises(RuntimeError, match="500"):
         client._raise_for(500, {"error": "boom"})

@@ -587,6 +587,11 @@ def test_auth_token_guards_mutating_endpoints(tmp_path):
         # a non-Bearer scheme never matches
         assert req("POST", "/runs", {**tiny, "seed": 1},
                    raw_auth="Basic s3kr1t")[0] == 401
+        # empty path segments must not dodge the gate: the dispatcher
+        # strips them, so the auth check has to see the same normalized
+        # path ("//runs" once skipped auth yet still dispatched)
+        assert req("POST", "//runs", {**tiny, "seed": 1})[0] == 401
+        assert req("POST", "///runs//", {**tiny, "seed": 1})[0] == 401
         s, r1 = req("POST", "/runs", {**tiny, "seed": 1}, token="s3kr1t")
         assert s == 201
         rid = r1["run_id"]
