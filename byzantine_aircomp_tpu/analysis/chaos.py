@@ -18,6 +18,10 @@ failure scenarios and asserts the recovery invariants:
   impossible in practice; recovery must still tolerate it by restarting
   the run from round 0 — the record stays identical, only wall-clock is
   lost).
+* ``kill_midckpt_rd4`` — the same torn-checkpoint recovery with
+  ``--rounds-per-dispatch 4``: the run executes as 4-round device scans
+  with R-boundary checkpoints (solo-routed), and the recovered record
+  must still match the uninterrupted multi-round baseline bit-for-bit.
 * ``poisoned``     — a tenant with a divergent config (``gamma`` huge)
   is quarantined (run_failed, status failed) while cotenants complete
   unperturbed in the same lowering.
@@ -233,13 +237,13 @@ def _assert_records_match(chaos_runs, base_runs, seeds) -> None:
         print(f"  seed {seed}: record bit-identical across kill -9")
 
 
-def _baseline(workdir: str, seeds, rounds: int) -> List[dict]:
+def _baseline(workdir: str, seeds, rounds: int, **overrides) -> List[dict]:
     """Run the same healthy tenants on a fresh root, uninterrupted."""
     root = os.path.join(workdir, "baseline")
     srv = Server(root, os.path.join(workdir, "baseline.log"))
     try:
         for seed in seeds:
-            srv.submit(seed=seed, rounds=rounds)
+            srv.submit(seed=seed, rounds=rounds, **overrides)
         return srv.wait_all_terminal()
     finally:
         srv.close()
@@ -293,11 +297,11 @@ def scenario_torn_tail(workdir: str) -> None:
     print("torn_tail: OK")
 
 
-def scenario_kill_midckpt(workdir: str) -> None:
+def _kill_midckpt(workdir: str, label: str, **overrides) -> None:
     root = os.path.join(workdir, "root")
     seeds, rounds = (1,), BASE_CFG["rounds"]
     srv = Server(root, os.path.join(workdir, "serve.log"))
-    rid = srv.submit(seed=seeds[0])
+    rid = srv.submit(seed=seeds[0], **overrides)
     srv.wait_round(rid, 2)
     srv.kill9()
     ckpts = glob.glob(os.path.join(root, rid, "**", "*.npz"), recursive=True)
@@ -311,9 +315,22 @@ def scenario_kill_midckpt(workdir: str) -> None:
         assert all(r["status"] == "completed" for r in runs), runs
     finally:
         srv2.close()
-    base = _baseline(workdir, seeds, rounds)
+    base = _baseline(workdir, seeds, rounds, **overrides)
     _assert_records_match(runs, base, seeds)
-    print("kill_midckpt: OK (run restarted from round 0, record identical)")
+    print(f"{label}: OK (run restarted from round 0, record identical)")
+
+
+def scenario_kill_midckpt(workdir: str) -> None:
+    _kill_midckpt(workdir, "kill_midckpt")
+
+
+def scenario_kill_midckpt_rd4(workdir: str) -> None:
+    """kill_midckpt under ``--rounds-per-dispatch 4``: the kill (and the
+    torn checkpoint) land against a run whose rounds are dispatched as
+    4-round device scans with R-boundary checkpoints — the recovered
+    record must still be bit-identical to an uninterrupted multi-round
+    baseline, proving the dispatch rim added no new torn-state window."""
+    _kill_midckpt(workdir, "kill_midckpt_rd4", rounds_per_dispatch=4)
 
 
 def scenario_poisoned(workdir: str) -> None:
@@ -882,6 +899,7 @@ SCENARIOS = {
     "edge_ledger": scenario_edge_ledger,
     "torn_tail": scenario_torn_tail,
     "kill_midckpt": scenario_kill_midckpt,
+    "kill_midckpt_rd4": scenario_kill_midckpt_rd4,
     "poisoned": scenario_poisoned,
     "slow_tenant": scenario_slow_tenant,
     "smoke": scenario_smoke,

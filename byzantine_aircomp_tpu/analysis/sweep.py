@@ -303,6 +303,11 @@ def main(argv=None) -> None:
         rollback_widen=args.rollback_widen,
         rollback_max=args.rollback_max,
         pop_shards=args.pop_shards,
+        rounds_per_dispatch=args.rounds_per_dispatch,
+        eval_interval=args.eval_interval,
+        dispatch_mode=args.dispatch_mode,
+        dispatch_prefetch=args.dispatch_prefetch,
+        async_writer=args.async_writer,
     )
     # stdout keeps one JSON object per completed cell (the shape scripts
     # already parse — schema stamps v/kind/ts are additive); --obs-dir tees

@@ -190,6 +190,38 @@ def add_knob_flags(p) -> None:
                         "reference engine otherwise; 1 = the legacy "
                         "single-scan program (requires --service on with "
                         "--cohort-size when > 1)")
+    # multi-round dispatch tier (fed/train.py _train_multi); the
+    # granularity knobs require --rounds-per-dispatch > 1
+    p.add_argument("--rounds-per-dispatch", type=int, default=1,
+                   help="run R rounds as ONE device scan per dispatch; "
+                        "records/events fold at dispatch exits, eval and "
+                        "checkpoints move to R-round boundaries; 1 = the "
+                        "exact per-round driver, bit-identical to builds "
+                        "without the tier (R must divide --rounds)")
+    p.add_argument("--eval-interval", type=int, default=0,
+                   help="rounds between boundary evals under R>1 (0 = "
+                        "every dispatch boundary; must be a multiple of "
+                        "R; skipped rounds replicate the last eval in "
+                        "the record)")
+    p.add_argument("--dispatch-mode", choices=["exact", "degraded"],
+                   default="exact",
+                   help="R>1 granularity contract: 'degraded' opts into "
+                        "R-boundary rollback/forensics granularity "
+                        "(required to combine R>1 with --service on "
+                        "--rollback on); 'exact' refuses combinations "
+                        "that would silently coarsen")
+    p.add_argument("--dispatch-prefetch", choices=["off", "on"],
+                   default="off",
+                   help="double-buffer the dispatch rim: launch dispatch "
+                        "i+1 before folding dispatch i's host records so "
+                        "host work overlaps device compute (timing-only; "
+                        "records bit-identical)")
+    p.add_argument("--async-writer", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="bounded single-consumer writer thread owning "
+                        "event appends, checkpoint serialization and the "
+                        "record pickle (auto = on iff "
+                        "--rounds-per-dispatch > 1); output-only")
 
 
 ARG_TO_FIELD = {
@@ -251,6 +283,11 @@ ARG_TO_FIELD = {
     "rollback_widen": ("rollback_widen", None),
     "rollback_max": ("rollback_max", None),
     "pop_shards": ("pop_shards", None),
+    "rounds_per_dispatch": ("rounds_per_dispatch", None),
+    "eval_interval": ("eval_interval", None),
+    "dispatch_mode": ("dispatch_mode", None),
+    "dispatch_prefetch": ("dispatch_prefetch", None),
+    "async_writer": ("async_writer", None),
     "profile_dir": ("profile_dir", None),
     "profile_rounds": ("profile_rounds", None),
     "hbm_warn_factor": ("hbm_warn_factor", None),

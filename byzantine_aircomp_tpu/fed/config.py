@@ -288,6 +288,45 @@ class FedConfig:
     # _SERVICE_KNOBS: the hash-skip condition is pop_shards == 1, not
     # service == "off"
     pop_shards: int = 1
+    # multi-round dispatch: run R rounds as ONE lax.scan dispatch
+    # (fed/train.py _build_multi_round_fn) instead of R round_fn
+    # dispatches.  1 (default) drives the legacy per-round loop
+    # byte-identically and is skipped from config_hash; > 1 folds the
+    # stacked [R, ...] scan outputs into records/events at dispatch exit,
+    # moves eval + checkpoint + divergence-guard decisions to R-round
+    # boundaries, and forks the hash/title lineage (`_rdN`) exactly like
+    # --cohort-size does (the scanned program reassociates float reduces
+    # across compilation units).  Fresh round budgets must divide by R;
+    # a resumed run may open with one alignment dispatch and close with
+    # one tail dispatch (each a distinct scan length -> one extra
+    # lowering, accepted and logged by the retrace audit).
+    rounds_per_dispatch: int = 1
+    # rounds between boundary evals under R>1: 0 (default) evaluates at
+    # every dispatch exit; a positive multiple of R evaluates only at
+    # those boundaries and replicates the last eval into the skipped
+    # rounds' record entries (degraded eval granularity, documented in
+    # docs/DESIGN.md)
+    eval_interval: int = 0
+    # R>1 granularity contract: "exact" refuses feature combinations
+    # whose semantics would silently coarsen (service-mode warm rollback
+    # guards every round today but can only guard dispatch boundaries
+    # under R>1); "degraded" opts into R-boundary rollback/forensics
+    # granularity.  R=1 is always exact and bit-identical to the
+    # pre-dispatch-tier driver.
+    dispatch_mode: str = "exact"
+    # double-buffer the dispatch rim: "on" launches dispatch i+1 before
+    # folding dispatch i's host outputs so host record/event work
+    # overlaps device compute.  Timing-only (roundsPerSec values change;
+    # the trajectory, records, and event payloads are bit-identical), so
+    # it is skipped from config_hash unconditionally like the obs knobs.
+    dispatch_prefetch: str = "off"
+    # async host rim (obs/writer.py): move checkpoint serialization,
+    # JSONL/event sink appends, and the record pickle onto a bounded
+    # single-consumer writer thread.  "auto" (default) enables it iff
+    # rounds_per_dispatch > 1; output-only (per-sink seq envelope and
+    # the run-end drain keep streams complete and ordered), so skipped
+    # from config_hash unconditionally.
+    async_writer: str = "auto"
 
     def participant_counts(self) -> tuple:
         """(honest, Byzantine) rows per iteration — the single source of
@@ -439,6 +478,14 @@ class FedConfig:
     # skips all three UNCONDITIONALLY (alongside obs_dir/log_file/...)
     # rather than via this tuple
     _FORENSICS_KNOBS = ("forensics_top", "flight_window")
+
+    # dispatch-tier knobs that require rounds_per_dispatch > 1 (fault-knob
+    # contract).  harness.config_hash reads this tuple to keep the hash of
+    # every R=1 config identical to pre-dispatch-tier builds; the two
+    # output-only members (dispatch_prefetch, async_writer) are NOT here —
+    # they are validated independently and hash-skipped unconditionally
+    # like the obs knobs.
+    _DISPATCH_KNOBS = ("eval_interval", "dispatch_mode")
 
     def defense_ladder_names(self) -> tuple:
         """The escalation ladder as a tuple of aggregator names."""
@@ -991,6 +1038,77 @@ class FedConfig:
                     "--forensics needs the round's full top-M merge "
                     "stream, which is not shard-mergeable; use "
                     "--pop-shards 1 for forensic runs"
+                )
+        if self.rounds_per_dispatch < 1:
+            raise ValueError(
+                f"rounds_per_dispatch must be >= 1, "
+                f"got {self.rounds_per_dispatch}"
+            )
+        if self.async_writer not in ("auto", "on", "off"):
+            raise ValueError(
+                f"async_writer must be auto, on, or off, "
+                f"got {self.async_writer!r}"
+            )
+        if self.dispatch_prefetch not in ("off", "on"):
+            raise ValueError(
+                f"dispatch_prefetch must be off or on, "
+                f"got {self.dispatch_prefetch!r}"
+            )
+        if self.dispatch_mode not in ("exact", "degraded"):
+            raise ValueError(
+                f"dispatch_mode must be exact or degraded, "
+                f"got {self.dispatch_mode!r}"
+            )
+        if self.rounds_per_dispatch == 1:
+            defaults = {f.name: f.default for f in dataclasses.fields(self)}
+            touched = sorted(
+                k for k in self._DISPATCH_KNOBS
+                if getattr(self, k) != defaults[k]
+            )
+            if self.dispatch_prefetch != "off":
+                touched = sorted(touched + ["dispatch_prefetch"])
+            if touched:
+                raise ValueError(
+                    f"dispatch knobs {touched} require "
+                    f"--rounds-per-dispatch > 1 (the R=1 driver is the "
+                    f"exact per-round loop; there is no dispatch "
+                    f"granularity to tune)"
+                )
+        else:
+            if self.rounds % self.rounds_per_dispatch:
+                raise ValueError(
+                    f"rounds_per_dispatch {self.rounds_per_dispatch} must "
+                    f"divide the round budget {self.rounds}: a fresh run "
+                    f"schedules only full R-round dispatches (a RESUMED "
+                    f"run may open with an alignment dispatch and close "
+                    f"with a tail dispatch, but the configured budget "
+                    f"itself must split cleanly)"
+                )
+            if self.eval_interval < 0:
+                raise ValueError(
+                    f"eval_interval must be >= 0, got {self.eval_interval}"
+                )
+            if self.eval_interval and (
+                self.eval_interval % self.rounds_per_dispatch
+            ):
+                raise ValueError(
+                    f"eval_interval {self.eval_interval} must be 0 (every "
+                    f"dispatch boundary) or a multiple of "
+                    f"rounds_per_dispatch {self.rounds_per_dispatch}: "
+                    f"evals only run between dispatches"
+                )
+            if (
+                self.service == "on"
+                and self.rollback == "on"
+                and self.dispatch_mode == "exact"
+            ):
+                raise ValueError(
+                    "--rounds-per-dispatch > 1 with --service on arms the "
+                    "warm-rollback divergence guard, which can only fire "
+                    "at dispatch boundaries under a multi-round scan; "
+                    "opt into that coarser granularity with "
+                    "--dispatch-mode degraded, or disable the guard with "
+                    "--rollback off, or keep --rounds-per-dispatch 1"
                 )
         return self
 

@@ -17,6 +17,7 @@ import time
 from typing import Dict, Optional
 
 import jax.numpy as jnp
+import numpy as np
 
 from .. import obs as obs_lib
 from ..data import datasets as data_lib
@@ -158,6 +159,16 @@ def run_title(cfg: FedConfig) -> str:
         # idiom: the lineage forks like --cohort-size), so sharded
         # checkpoints never alias the single-scan trajectory
         title += f"_ps{cfg.pop_shards}"
+    if cfg.rounds_per_dispatch > 1:
+        # the multi-round scan is a separately compiled program (float
+        # re-association vs the per-round loop — cohort idiom), and its
+        # eval/checkpoint cadence is R-boundary, so dispatch-tier
+        # checkpoints never alias the exact per-round trajectory
+        title += f"_rd{cfg.rounds_per_dispatch}"
+        if _non_default(cfg, "eval_interval"):
+            title += f"_ev{cfg.eval_interval}"
+        if _non_default(cfg, "dispatch_mode"):
+            title += f"_{cfg.dispatch_mode}"
     if _non_default(cfg, "prng_impl"):
         title += f"_prng{cfg.prng_impl}"
     if _non_default(cfg, "stack_dtype"):
@@ -220,6 +231,11 @@ def config_hash(cfg: FedConfig) -> str:
         # derives everything from the event stream on the host — same
         # output-only contract, skipped UNCONDITIONALLY
         "metrics", "metrics_port", "alerts", "obs_rotate_mb",
+        # the async writer rim relocates WHERE/WHEN bytes hit disk, and
+        # dispatch prefetch only reorders host folds against device
+        # compute — both leave the trajectory and every record payload
+        # bit-identical, so they are output-only knobs like the obs trio
+        "async_writer", "dispatch_prefetch",
     )
     if cfg.defense == "off":
         # a defense-off config must hash identically to builds that
@@ -249,6 +265,13 @@ def config_hash(cfg: FedConfig) -> str:
         # must hash identically to builds that predate the sign_bits
         # field — the 32 default is byte-identical to the old path
         skip = skip + ("sign_bits",)
+    if cfg.rounds_per_dispatch == 1:
+        # dispatch-tier continuity: an R=1 config must hash identically
+        # to builds that predate the multi-round dispatch fields
+        # (validate() pins the dispatch knobs to their defaults at R=1,
+        # so skipping drops nothing); R>1 forks the lineage — the scan
+        # is a separately compiled program with R-boundary eval cadence
+        skip = skip + ("rounds_per_dispatch",) + FedConfig._DISPATCH_KNOBS
     items = sorted(
         (f.name, repr(getattr(cfg, f.name)))
         for f in dataclasses.fields(cfg)
@@ -561,7 +584,11 @@ def run(
     # text (ending in a SIGILL warning) collapses to one summary line;
     # the full text survives only under --log-file
     restore_stderr = env_lib.condense_stderr_warnings(cfg.log_file)
-    obs = obs_lib.from_config(cfg, ckpt_title(cfg))
+    # async host rim (obs/writer.py): one bounded single-consumer thread
+    # owns event appends, checkpoint serialization and the record pickle
+    # when --async-writer resolves on (auto: iff rounds_per_dispatch > 1)
+    writer = obs_lib.WriterThread() if obs_lib.resolve_async(cfg) else None
+    obs = obs_lib.from_config(cfg, ckpt_title(cfg), writer=writer)
     if cfg.metrics_port > 0:
         # scrape endpoint up BEFORE training so /metrics answers while
         # the first round is still compiling; obs.close() (the finally
@@ -578,9 +605,18 @@ def run(
         return _run_inner(
             cfg, record_in_file, obs,
             persist_paths=persist_paths, on_checkpoint=on_checkpoint,
+            writer=writer,
         )
     finally:
+        # run-end drain contract: every enqueued append/checkpoint/pickle
+        # lands before the sinks close, so crash and clean exit both
+        # leave complete, seq-ordered streams (AsyncSink.close drains
+        # again — idempotent — before closing its inner sink)
+        if writer is not None:
+            writer.drain()
         obs.close()
+        if writer is not None:
+            writer.close()
         restore_stderr()
         restore_log()
 
@@ -591,6 +627,7 @@ def _run_inner(
     obs,
     persist_paths: bool = False,
     on_checkpoint=None,
+    writer=None,
 ) -> Dict:
     from ..obs import hbm as hbm_lib
     from ..obs import profile as profile_lib
@@ -621,19 +658,48 @@ def _run_inner(
                 import json as _json
 
                 meta = _json.dumps(t._last_paths)
-            checkpoint.save(
-                cfg.checkpoint_dir,
-                title,
-                r,
-                t.flat_params,
-                jax.tree.leaves(extra_state(t, cfg)),
-                meta=meta,
-            )
-            if on_checkpoint is not None:
-                on_checkpoint(r)
+            flat = t.flat_params
+            leaves = jax.tree.leaves(extra_state(t, cfg))
+            if writer is None:
+                checkpoint.save(
+                    cfg.checkpoint_dir, title, r, flat, leaves, meta=meta,
+                )
+                if on_checkpoint is not None:
+                    on_checkpoint(r)
+                return
+            # async rim: serialize OFF the round loop.  The state must be
+            # snapshotted host-side NOW — every carry slot is donated to
+            # the next dispatch, so by the time the writer runs, the
+            # device buffers behind a lazy view may have been reused
+            # (same hazard as the trainer's rollback snapshot).  The save
+            # and its journal callback ride as ONE task so a checkpoint
+            # can never be journaled before its bytes are durable.
+            flat = np.array(flat, copy=True)
+            leaves = [np.array(leaf, copy=True) for leaf in leaves]
+
+            def _save_task():
+                checkpoint.save(
+                    cfg.checkpoint_dir, title, r, flat, leaves, meta=meta,
+                )
+                if on_checkpoint is not None:
+                    on_checkpoint(r)
+
+            writer.submit(_save_task)
 
         if cfg.inherit:
-            restored = checkpoint.load(cfg.checkpoint_dir, title)
+            # a torn npz (killed mid-write before the atomic rename ever
+            # existed, or corrupted at rest) must degrade to a round-0
+            # restart — the trajectory replays identically, only
+            # wall-clock is lost (chaos kill_midckpt_rd4 drives this on
+            # the solo-routed dispatch path)
+            try:
+                restored = checkpoint.load(cfg.checkpoint_dir, title)
+            except Exception as exc:
+                log(
+                    f"Unreadable checkpoint ({type(exc).__name__}: {exc}); "
+                    f"restarting from round 0"
+                )
+                restored = None
             if restored is not None:
                 if persist_paths:
                     # grab the paths prefix BEFORE the resumed run's own
@@ -735,6 +801,17 @@ def _run_inner(
     retrace = getattr(trainer, "retrace", None)
     if retrace is not None:
         steady_ok = retrace.check("round_fn", max_lowerings=1, warn_fn=log)
+        if cfg.rounds_per_dispatch > 1:
+            # the dispatch tier drives multi_round_fn instead; a fresh
+            # aligned run lowers it exactly once (an unaligned resume
+            # legitimately adds an alignment/tail scan length, which
+            # this audit then flags on the log for the operator to read)
+            steady_ok = (
+                retrace.check(
+                    "multi_round_fn", max_lowerings=1, warn_fn=log
+                )
+                and steady_ok
+            )
         obs.emit("retrace", counts=retrace.snapshot(), steady_state_ok=steady_ok)
     # forensics full: the run-end flight dump (the window's final state is
     # the on-demand complement of the per-rollback dumps the trainer wrote)
@@ -873,5 +950,13 @@ def _run_inner(
         max_feature=int(trainer.dataset.x_train[0].size),
     )
     if record_in_file:
-        io_lib.atomic_pickle(path, record)
+        if writer is not None:
+            # the pickle rides the writer (ordering: after every pending
+            # checkpoint), then drains so the record is durable before
+            # run() returns — callers (chaos harness, the server's solo
+            # lane) read the file immediately
+            writer.submit(lambda: io_lib.atomic_pickle(path, record))
+            writer.drain()
+        else:
+            io_lib.atomic_pickle(path, record)
     return record

@@ -1790,27 +1790,32 @@ class FedTrainer:
         return self._round_core
 
     def _build_multi_round_fn(self):
-        """n rounds in ONE device program: an outer scan over round indices.
+        """n rounds in ONE device program: an outer scan over round keys.
 
-        Per-round keys are the same ``fold_in(PRNGKey(seed), round)``
-        derivation as :meth:`run_round`, so ``run_rounds(r0, n)`` consumes
-        the identical RNG stream as n successive ``run_round`` calls and
+        The scan consumes a precomputed ``[n]`` array of per-round keys
+        (:meth:`_round_keys`) — the same ``fold_in(PRNGKey(seed), round)``
+        derivation as :meth:`run_round`, including the host-side
+        rollback-epoch salt — so ``run_rounds(r0, n)`` consumes the
+        identical RNG stream as n successive ``run_round`` calls and
         removes only the per-round host dispatch (a few ms each on a
-        tunneled chip).  Trajectories agree up to the float re-association
-        of a separately compiled XLA program (ulp-level per step; see
+        tunneled chip).  Deriving keys on the host keeps epoch salting out
+        of the traced program: a warm-rollback re-run changes only the key
+        VALUES, never the scan's shape, so the one-lowering contract
+        holds across restores.  Trajectories agree with the per-round
+        loop up to the float re-association of a separately compiled XLA
+        program (ulp-level per step; see
         tests/test_training.py::test_run_rounds_matches_run_round_loop)."""
-        base_key = self._base_key
 
         def multi_fn(
             flat_params, opt_state, client_m, fault_state, defense_state,
-            attack_iter, service_state, rounds, x_train, y_train,
+            attack_iter, service_state, round_keys, x_train, y_train,
         ):
-            def body(carry, r):
+            def body(carry, round_key):
                 fp, os, cm, fs, ds, ai, ss = carry
                 fp, os, cm, fs, ds, ai, ss, var, fm, dm, sm, fo = (
                     self._round_core(
                         fp, os, cm, fs, ds, ai, ss,
-                        jax.random.fold_in(base_key, r), x_train, y_train,
+                        round_key, x_train, y_train,
                     )
                 )
                 return (fp, os, cm, fs, ds, ai, ss), (var, fm, dm, sm, fo)
@@ -1824,7 +1829,7 @@ class FedTrainer:
                 body,
                 (flat_params, opt_state, client_m, fault_state,
                  defense_state, attack_iter, service_state),
-                rounds,
+                round_keys,
             )
             return (
                 final, opt_final, m_final, f_final, d_final, a_final,
@@ -1910,15 +1915,32 @@ class FedTrainer:
         )
         return variance
 
-    def run_rounds(self, start_round: int, num_rounds: int) -> jax.Array:
+    def _round_keys(self, start_round: int, num_rounds: int) -> jax.Array:
+        """The ``[num_rounds]`` per-round key array a multi-round dispatch
+        scans over: ``fold_in(seed, round)``, epoch-salted exactly like
+        :meth:`run_round` when a warm rollback has fired.  Host-side by
+        design — the salt changes key values, not the traced program."""
+        rounds = jnp.arange(
+            start_round, start_round + num_rounds, dtype=jnp.int32
+        )
+        keys = jax.vmap(
+            lambda r: jax.random.fold_in(self._base_key, r)
+        )(rounds)
+        if self._rollback_epoch:
+            epoch = self._rollback_epoch
+            keys = jax.vmap(
+                lambda k: jax.random.fold_in(k, epoch)
+            )(keys)
+        return keys
+
+    def run_rounds_stacked(self, start_round: int, num_rounds: int):
         """Execute ``num_rounds`` rounds as ONE dispatched program (outer
-        ``lax.scan`` over rounds); returns the per-round honest-dispersion
-        metrics [num_rounds] as a device array.  Same RNG stream and
-        semantics as calling :meth:`run_round` in a loop (numerically equal
-        up to separate-compilation float re-association) — use this when
-        nothing (eval, logging, checkpointing) needs the params between
-        rounds, e.g. benchmarking."""
-        rounds = jnp.arange(start_round, start_round + num_rounds, dtype=jnp.int32)
+        ``lax.scan`` over per-round keys); returns the stacked per-round
+        outputs ``(variances, fault_ms, defense_ms, service_ms,
+        forensic_ms)`` as device arrays of leading dim ``num_rounds``
+        (``()`` for each subsystem that is off).  No host sync happens
+        here — the multi-round driver folds these into records/events at
+        dispatch exit, benchmarks only force the final params."""
         (
             self.flat_params, self.server_opt_state, self.client_m,
             self.fault_state, self.defense_state, self.attack_iter,
@@ -1926,7 +1948,8 @@ class FedTrainer:
         ) = self._multi_round_fn(
             self.flat_params, self.server_opt_state, self.client_m,
             self.fault_state, self.defense_state, self.attack_iter,
-            self.service_state, rounds, self.x_train, self.y_train,
+            self.service_state, self._round_keys(start_round, num_rounds),
+            self.x_train, self.y_train,
         )
         # [num_rounds, 4] / [num_rounds, 6] stacked rows (the LAST round's
         # row is what run_round would have reported); () when off
@@ -1942,7 +1965,16 @@ class FedTrainer:
         self.last_forensic_metrics = (
             fos[-1] if self._forensics_on else ()
         )
-        return variances
+        return variances, fms, dms, sms, fos
+
+    def run_rounds(self, start_round: int, num_rounds: int) -> jax.Array:
+        """Execute ``num_rounds`` rounds as ONE dispatched program; returns
+        the per-round honest-dispersion metrics [num_rounds] as a device
+        array.  Same RNG stream and semantics as calling :meth:`run_round`
+        in a loop (numerically equal up to separate-compilation float
+        re-association) — use this when nothing (eval, logging,
+        checkpointing) needs the params between rounds, e.g. benchmarking."""
+        return self.run_rounds_stacked(start_round, num_rounds)[0]
 
     def train(
         self,
@@ -2029,6 +2061,16 @@ class FedTrainer:
             f"train: loss={tr_loss:.4f} acc={tr_acc:.4f} "
             f"val: loss={va_loss:.4f} acc={va_acc:.4f}"
         )
+
+        if cfg.rounds_per_dispatch > 1:
+            # dispatch tier: R rounds per device program, host rim folded
+            # at dispatch exits.  The R=1 loop below stays byte-identical
+            # to the pre-dispatch-tier driver — that bit-identity IS the
+            # exact-mode contract (tests/test_training.py pins it).
+            return self._train_multi(
+                paths, (tr_loss, tr_acc, va_loss, va_acc), eval_pair,
+                prev_rung, log, checkpoint_fn, start_round, obs, profiler,
+            )
 
         # warm rollback (service rounds): keep a host-side copy of the last
         # GOOD end-of-round state; when the divergence guard trips, restore
@@ -2281,6 +2323,360 @@ class FedTrainer:
                     checkpoint_fn(r + 1, self)
             profiler.round_end(r)  # window mode: close trace leaving [A, B)
             r += 1
+        return paths
+
+    def _train_multi(
+        self,
+        paths: Dict[str, List[float]],
+        evals: tuple,
+        eval_pair: Callable,
+        prev_rung: Optional[int],
+        log: Callable[[str], None],
+        checkpoint_fn: Optional[Callable[[int, "FedTrainer"], None]],
+        start_round: int,
+        obs: "obs_lib.Observability",
+        profiler: "obs_lib.Profiler",
+    ) -> Dict[str, List[float]]:
+        """The R>1 dispatch-tier driver: ``ceil(rounds/R)`` multi-round
+        scans, with the host rim (record appends, event emission, eval,
+        divergence guard, checkpoints) folded at dispatch exits.
+
+        Granularity contract (docs/DESIGN.md "Exact vs degraded"):
+
+        * eval runs at dispatch boundaries (every boundary by default;
+          every ``eval_interval`` rounds when set) and the boundary values
+          are replicated into the dispatch's per-round record entries —
+          per-round eval does not exist because the params between scanned
+          rounds never reach the host;
+        * the warm-rollback divergence guard (``--dispatch-mode degraded``
+          opt-in) fires at dispatch exits and restores the previous
+          BOUNDARY snapshot, re-running the whole dispatch under
+          epoch-salted keys;
+        * checkpoints land at sync boundaries, so resume granularity is R
+          rounds;
+        * per-round metric rows (variance, fault/defense/service/forensic
+          columns) keep EXACT per-round fidelity — they come out of the
+          scan stacked ``[n, ...]`` and are bit-equal to the
+          :meth:`run_rounds` oracle;
+        * ``roundsPerSec`` entries report the amortized per-round rate
+          ``n / dt`` of the dispatch that produced them.
+
+        With ``--dispatch-prefetch on``, a boundary with no sync work (no
+        eval due, no guard, no flight recorder) defers its host fold until
+        the NEXT dispatch has launched, so record/event work overlaps
+        device compute (the stacked scan outputs are fresh buffers — only
+        the 7 carry slots are donated — so they survive the next launch).
+        A resumed run may open with one alignment dispatch and close with
+        one tail dispatch; each distinct scan length is one extra lowering
+        of ``multi_round_fn``, which the harness retrace audit expects."""
+        cfg = self.cfg
+        tr_loss, tr_acc, va_loss, va_acc = evals
+        R = cfg.rounds_per_dispatch
+        eval_every = cfg.eval_interval or R
+        prefetch = cfg.dispatch_prefetch == "on"
+        rollback_armed = cfg.service == "on" and cfg.rollback == "on"
+        snapshot = None
+        recent_val: List[float] = []
+
+        def _state_tuple():
+            return (
+                self.flat_params, self.server_opt_state, self.client_m,
+                self.fault_state, self.defense_state, self.attack_iter,
+                self.service_state,
+            )
+
+        def fold_dispatch(r0, n, t0, dt, compiled, outs):
+            """Fold one dispatch's stacked [n, ...] outputs into the
+            per-round record paths and event stream.  ``dt`` is None for
+            a deferred (prefetched) fold — measured here instead, after
+            the blocking host conversion of the stacked outputs."""
+            nonlocal prev_rung
+            variances, fms, dms, sms, fos = outs
+            var_np = np.asarray(variances)
+            fault_np = (
+                np.asarray(fms) if self.fault is not None else None
+            )
+            defense_np = (
+                np.asarray(dms) if self.defense is not None else None
+            )
+            service_np = (
+                np.asarray(sms) if cfg.service == "on" else None
+            )
+            forensic_np = (
+                np.asarray(fos) if self._forensics_on else None
+            )
+            if dt is None:
+                dt = time.perf_counter() - t0
+            rps = n / dt
+            memory = obs_lib.device_memory() if obs.enabled else None
+            var_str = ""
+            for i in range(n):
+                rr = r0 + i
+                paths["trainLossPath"].append(tr_loss)
+                paths["trainAccPath"].append(tr_acc)
+                paths["valLossPath"].append(va_loss)
+                paths["valAccPath"].append(va_acc)
+                paths["variencePath"].append(float(var_np[i]))
+                # amortized per-round rate of the dispatch (satellite
+                # contract: rounds_per_sec_floor alerting and the harness
+                # rounds/sec summary both stay meaningful under R>1)
+                paths["roundsPerSec"].append(rps)
+                var_str = (
+                    f" var={cfg.noise_var:.2e}"
+                    if cfg.noise_var is not None else ""
+                )
+                fault_metrics = None
+                if fault_np is not None:
+                    dropped, erased, corrupt, eff_k = (
+                        float(v) for v in fault_np[i]
+                    )
+                    paths["faultDroppedPath"].append(dropped)
+                    paths["faultErasedPath"].append(erased)
+                    paths["faultCorruptPath"].append(corrupt)
+                    paths["effectiveKPath"].append(eff_k)
+                    fault_metrics = {
+                        "dropped": dropped,
+                        "erased": erased,
+                        "corrupt": corrupt,
+                        "effective_k": eff_k,
+                    }
+                    var_str += (
+                        f" effK={eff_k:.0f} drop={dropped:.0f} "
+                        f"erase={erased:.0f} corrupt={corrupt:.0f}"
+                    )
+                service_metrics = None
+                if service_np is not None:
+                    avail_m, absent_m, late_m, eff_k = (
+                        float(v) for v in service_np[i]
+                    )
+                    paths["serviceAvailPath"].append(avail_m)
+                    paths["serviceAbsentPath"].append(absent_m)
+                    paths["serviceLatePath"].append(late_m)
+                    paths["effectiveKPath"].append(eff_k)
+                    service_metrics = {
+                        "available": avail_m,
+                        "absent": absent_m,
+                        "late": late_m,
+                        "effective_k": eff_k,
+                    }
+                    obs.emit("participation", round=rr, **service_metrics)
+                    var_str += (
+                        f" avail={avail_m:.0f} effK={eff_k:.0f} "
+                        f"late={late_m:.0f}"
+                    )
+                if defense_np is not None:
+                    dmetrics = defense_lib.events.round_metrics(
+                        defense_np[i]
+                    )
+                    for dkey, path_key in (
+                        defense_lib.events.PATH_KEYS.items()
+                    ):
+                        paths[path_key].append(dmetrics[dkey])
+                    agg_name = defense_lib.events.active_agg(
+                        self.defense.mode, self.defense.ladder,
+                        int(dmetrics["rung"]), cfg.agg,
+                    )
+                    defense_lib.events.emit_round(
+                        obs, rr, mode=self.defense.mode, agg=agg_name,
+                        metrics=dmetrics, prev_rung=prev_rung,
+                    )
+                    prev_rung = int(dmetrics["rung"])
+                    var_str += (
+                        f" rung={int(dmetrics['rung'])}({agg_name}) "
+                        f"flag={dmetrics['flagged']:.0f}"
+                    )
+                if forensic_np is not None and obs.enabled:
+                    forensics_lib.emit_round_flags(
+                        obs, rr, forensic_np[i], mode=cfg.forensics
+                    )
+                obs.round(
+                    rr,
+                    train_loss=tr_loss,
+                    train_acc=tr_acc,
+                    val_loss=va_loss,
+                    val_acc=va_acc,
+                    variance=float(var_np[i]),
+                    round_secs=dt / n,
+                    rounds_per_sec=rps,
+                    compiled=compiled,
+                    fault_metrics=fault_metrics,
+                    service_metrics=service_metrics,
+                    memory=memory,
+                )
+            if forensic_np is not None and self.flight_recorder is not None:
+                # R-boundary forensics granularity: ONE flight-recorder
+                # entry per dispatch, carrying the exit-round detector
+                # carry and the last stacked forensic rows (the per-round
+                # carries never reach the host under a scan)
+                det_s, pol_s = self.defense_state
+                self.flight_recorder.record(
+                    r0 + n - 1,
+                    detector_state=det_s,
+                    policy_state=pol_s,
+                    defense_metrics=self.last_defense_metrics,
+                    forensic_rows=forensic_np[-1],
+                    summary={
+                        "val_loss": va_loss,
+                        "val_acc": va_acc,
+                        "variance": float(var_np[-1]),
+                    },
+                )
+            log(
+                f"[{r0 + n}/{cfg.rounds}]"
+                f"(interval: {cfg.display_interval}, dispatch: {n}) "
+                f"train: loss={tr_loss:.4f} acc={tr_acc:.4f} "
+                f"val: loss={va_loss:.4f} acc={va_acc:.4f}{var_str}"
+            )
+
+        r = start_round
+        pending = None  # deferred fold: (r0, n, t0, compiled, outs)
+        while r < cfg.rounds:
+            # alignment dispatch on an unaligned resume, tail dispatch on
+            # an unaligned end — each a distinct scan length (extra
+            # lowering), every steady dispatch exactly R rounds
+            rem = r % R
+            n = min(R - rem if rem else R, cfg.rounds - r)
+            end = r + n
+            profiler.round_start(r)
+            lowerings_before = self.retrace.count("multi_round_fn")
+            t0 = time.perf_counter()
+            with obs.span("dispatch", round=r, rounds=n) as sp, \
+                    profiler.step(r):
+                outs = self.run_rounds_stacked(r, n)
+                compiled = (
+                    self.retrace.count("multi_round_fn") > lowerings_before
+                )
+                sp["compiled"] = compiled
+            if pending is not None:
+                # double buffer: fold the PREVIOUS dispatch's host rim
+                # while the device runs this one
+                p_r0, p_n, p_t0, p_compiled, p_outs = pending
+                fold_dispatch(p_r0, p_n, p_t0, None, p_compiled, p_outs)
+                pending = None
+            # the armed guard needs a fresh boundary eval to judge (the
+            # R=1 loop evaluates every round for the same reason)
+            do_eval = (
+                (end % eval_every == 0)
+                or end >= cfg.rounds
+                or rollback_armed
+            )
+            sync = (
+                not prefetch
+                or do_eval
+                or rollback_armed
+                or self.flight_recorder is not None
+                or end >= cfg.rounds
+            )
+            if not sync:
+                pending = (r, n, t0, compiled, outs)
+                profiler.round_end(r)
+                r = end
+                continue
+            jax.block_until_ready(self.flat_params)
+            dt = time.perf_counter() - t0
+            if do_eval:
+                with obs.span("eval", stage="round", round=end), \
+                        profiler.phase("eval"):
+                    (tr_loss, tr_acc), (va_loss, va_acc) = eval_pair()
+            if rollback_armed:
+                # R-boundary divergence guard (degraded granularity, the
+                # --dispatch-mode degraded opt-in): judged on the
+                # dispatch's EXIT round; a trip discards the whole
+                # dispatch and re-runs it from the previous boundary
+                # snapshot under epoch-salted keys
+                var_f = float(np.asarray(outs[0])[-1])
+                reason = None
+                if not (
+                    math.isfinite(tr_loss) and math.isfinite(va_loss)
+                    and math.isfinite(var_f)
+                ):
+                    reason = "non_finite"
+                elif (
+                    self.defense is not None
+                    and cfg.rollback_cusum > 0.0
+                    and float(np.asarray(self.last_defense_metrics)[4])
+                    >= cfg.rollback_cusum
+                ):
+                    reason = "cusum_spike"
+                elif len(recent_val) >= 3:
+                    med = sorted(recent_val)[len(recent_val) // 2]
+                    if va_loss > cfg.rollback_loss_factor * max(med, 1e-3):
+                        reason = "loss_spike"
+                if (
+                    reason is not None
+                    and snapshot is not None
+                    and self._rollbacks_done < cfg.rollback_max
+                ):
+                    if self.flight_recorder is not None:
+                        det_s, pol_s = self.defense_state
+                        self.flight_recorder.record(
+                            end - 1,
+                            detector_state=det_s,
+                            policy_state=pol_s,
+                            defense_metrics=self.last_defense_metrics,
+                            forensic_rows=np.asarray(
+                                self.last_forensic_metrics
+                            ),
+                            summary={
+                                "val_loss": va_loss,
+                                "diverged": True,
+                                "reason": reason,
+                            },
+                        )
+                    host_state, shardings, snap_round = snapshot
+                    (
+                        self.flat_params, self.server_opt_state,
+                        self.client_m, self.fault_state,
+                        self.defense_state, self.attack_iter,
+                        self.service_state,
+                    ) = jax.tree.map(jax.device_put, host_state, shardings)
+                    avail, widen = self.service_state
+                    self.service_state = (
+                        avail, widen * jnp.float32(cfg.rollback_widen)
+                    )
+                    self._rollbacks_done += 1
+                    self._rollback_epoch = self._rollbacks_done
+                    obs.emit(
+                        "rollback", round=end - 1,
+                        restored_round=snap_round, reason=reason,
+                        epoch=self._rollback_epoch,
+                        widen=float(widen) * cfg.rollback_widen,
+                    )
+                    if self.flight_recorder is not None:
+                        self.flight_recorder.dump(end - 1, reason, obs=obs)
+                    log(
+                        f"[rollback {self._rollbacks_done}"
+                        f"/{cfg.rollback_max}] dispatch ending round {end} "
+                        f"diverged ({reason}); restored round "
+                        f"{snap_round}, trim widened "
+                        f"x{cfg.rollback_widen:.2f}"
+                    )
+                    profiler.round_end(r)
+                    continue
+            fold_dispatch(r, n, t0, dt, compiled, outs)
+            if rollback_armed:
+                recent_val.append(va_loss)
+                if len(recent_val) > 8:
+                    recent_val.pop(0)
+                # same donation hazard as the R=1 loop: copy=True or the
+                # snapshot rots when the next dispatch reuses the buffers
+                state = _state_tuple()
+                snapshot = (
+                    jax.tree.map(lambda x: np.array(x, copy=True), state),
+                    jax.tree.map(lambda x: x.sharding, state),
+                    end,
+                )
+            if checkpoint_fn is not None:
+                with obs.span("checkpoint", round=end), \
+                        profiler.phase("checkpoint"):
+                    checkpoint_fn(end, self)
+            profiler.round_end(r)
+            r = end
+        if pending is not None:
+            # unreachable (run end is always a sync boundary), kept as a
+            # belt so a future cadence change cannot silently drop a fold
+            p_r0, p_n, p_t0, p_compiled, p_outs = pending
+            fold_dispatch(p_r0, p_n, p_t0, None, p_compiled, p_outs)
         return paths
 
     @property

@@ -63,6 +63,7 @@ from .sinks import (  # noqa: F401
     StdoutSink,
 )
 from .span import SpanTimer
+from .writer import AsyncSink, WriterThread, resolve_async  # noqa: F401
 
 
 class Observability:
@@ -124,11 +125,20 @@ def events_path(obs_dir: str, title: str) -> str:
     return os.path.join(obs_dir, f"{title}.events.jsonl")
 
 
-def from_config(cfg, title: str) -> Observability:
+def from_config(
+    cfg, title: str, writer: Optional[WriterThread] = None
+) -> Observability:
     """Build the configured Observability for a run (``NULL`` when no
     obs knob is set).  ``--metrics-port`` and ``--alerts`` imply the
     metrics registry; the registry implies nothing else — a
-    metrics-only run writes no file and prints no event."""
+    metrics-only run writes no file and prints no event.
+
+    ``writer`` (the harness's async rim, obs/writer.py) moves the I/O
+    sinks — JSONL file and stdout — behind :class:`AsyncSink` so event
+    appends leave the round critical path.  The metrics sink stays
+    SYNCHRONOUS regardless: the alert engine samples the registry right
+    after each round event inside :meth:`Observability.round`, so the
+    registry must fold the event before that call returns."""
     sinks = []
     if getattr(cfg, "obs_dir", ""):
         sinks.append(
@@ -139,6 +149,8 @@ def from_config(cfg, title: str) -> Observability:
         )
     if getattr(cfg, "obs_stdout", False):
         sinks.append(StdoutSink())
+    if writer is not None:
+        sinks = [AsyncSink(s, writer) for s in sinks]
     metrics_on = (
         getattr(cfg, "metrics", "off") == "on"
         or getattr(cfg, "metrics_port", 0) > 0

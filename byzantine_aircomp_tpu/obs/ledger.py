@@ -68,6 +68,11 @@ LEDGER_EXTRA_FIELDS = (
     # over the population mesh (pop_shards > 1 is part of the config key)
     "population",
     "peak_per_host_modeled_bytes",
+    # multi-round dispatch tier (BENCH_MULTIROUND): how many rounds each
+    # device dispatch scanned — the R axis of the dispatch-rim sweep the
+    # ≥10x acceptance gate reads (the R value is also baked into the
+    # metric name, so same-R rows regression-test against each other)
+    "rounds_per_dispatch",
 )
 
 #: relative band half-width tolerated as noise (±10%)

@@ -1,0 +1,160 @@
+"""The async host rim: a bounded single-consumer writer thread.
+
+Under ``--rounds-per-dispatch`` the jitted round program costs
+milliseconds and the host rim — JSONL event appends, checkpoint
+serialization, the end-of-run record pickle — becomes the critical
+path.  This module moves that rim onto ONE daemon consumer thread so
+the dispatch loop enqueues and returns; it never touches the disk.
+
+Ordering contract
+-----------------
+A single consumer drains a single FIFO queue, so tasks run in exactly
+the order they were submitted.  :class:`AsyncSink` rides this: the
+inner sink stamps its monotonic per-sink ``seq`` envelope (see
+``obs/sinks.py``) ON the writer thread, so the drained stream is
+seq-ordered even when multiple producer threads raced on ``emit`` —
+whatever interleaving won the queue IS the stream order.  Checkpoint
+saves and their journal callbacks are submitted as ONE task, so a
+checkpoint can never be journaled before its bytes are durable.
+
+Backpressure, not loss
+----------------------
+The queue is bounded (``maxsize``); a full queue blocks the producer in
+``submit`` until the consumer catches up.  A slow disk therefore slows
+the run down gracefully — it never drops events and never grows the
+queue without bound.
+
+Failure degradation
+-------------------
+A task that raises is recorded (first error kept on ``.error``, one
+stderr warning) and the consumer keeps draining — mirroring
+``JsonlSink``'s degrade-on-OSError contract: a failing sink must not
+deadlock or kill training.  ``drain()`` blocks until every task
+submitted so far has finished; the harness drains before sinks close so
+run end never races the rim.
+"""
+
+from __future__ import annotations
+
+import queue
+import sys
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from .sinks import EventSink
+
+_STOP = object()
+
+
+def resolve_async(cfg) -> bool:
+    """Whether the harness should stand up a writer thread for ``cfg``:
+    ``--async-writer on`` forces it, ``off`` forbids it, and ``auto``
+    (default) enables it exactly when the multi-round dispatch tier is
+    active (R=1 runs keep the synchronous rim and stay bit-identical in
+    behavior AND timing to the pre-writer builds)."""
+    mode = getattr(cfg, "async_writer", "auto")
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    return getattr(cfg, "rounds_per_dispatch", 1) > 1
+
+
+class WriterThread:
+    """Bounded single-consumer task queue on a daemon thread.
+
+    ``submit(fn)`` enqueues a zero-arg callable (blocking at the bound),
+    ``drain()`` waits for everything submitted so far, ``close()`` drains
+    and joins the thread.  After ``close`` a late ``submit`` runs the
+    task inline — teardown paths degrade to the synchronous rim instead
+    of losing work.
+    """
+
+    def __init__(self, maxsize: int = 256, name: str = "obs-writer") -> None:
+        self._q: "queue.Queue" = queue.Queue(maxsize)
+        self._error: Optional[BaseException] = None
+        self._warned = False
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        """The FIRST task failure, if any (later ones only count)."""
+        return self._error
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        """Enqueue ``fn``; blocks while the queue is at its bound
+        (backpressure — a slow consumer throttles the producer, it never
+        drops work)."""
+        if self._closed:
+            self._run(fn)
+            return
+        self._q.put(fn)
+
+    def _run(self, fn: Callable[[], None]) -> None:
+        try:
+            fn()
+        except BaseException as exc:  # noqa: BLE001 - degrade, don't die
+            if self._error is None:
+                self._error = exc
+            if not self._warned:
+                self._warned = True
+                print(
+                    f"[obs] WARNING: async writer task failed "
+                    f"({type(exc).__name__}: {exc}); the writer keeps "
+                    f"draining and the run continues",
+                    file=sys.stderr,
+                )
+
+    def _loop(self) -> None:
+        while True:
+            fn = self._q.get()
+            try:
+                if fn is _STOP:
+                    return
+                self._run(fn)
+            finally:
+                self._q.task_done()
+
+    def drain(self) -> None:
+        """Block until every task submitted so far has run (the run-end
+        contract: records/streams are complete when this returns)."""
+        self._q.join()
+
+    def close(self) -> None:
+        """Drain and stop the consumer.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(_STOP)
+        self._thread.join()
+
+
+class AsyncSink(EventSink):
+    """Rides an inner sink on a :class:`WriterThread`.
+
+    ``emit`` enqueues the inner emit — the inner sink stamps its ``seq``
+    envelope on the writer thread, where the single consumer serializes
+    stamping and appending into one total order.  ``flush``/``close``
+    drain first, so a closed stream is complete and seq-monotonic with
+    zero lost events.
+    """
+
+    def __init__(self, inner: EventSink, writer: WriterThread) -> None:
+        self.inner = inner
+        self._writer = writer
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        inner = self.inner
+        self._writer.submit(lambda: inner.emit(event))
+
+    def flush(self) -> None:
+        self._writer.drain()
+        self.inner.flush()
+
+    def close(self) -> None:
+        self._writer.drain()
+        self.inner.close()

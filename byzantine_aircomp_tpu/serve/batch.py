@@ -95,6 +95,11 @@ _OUTPUT_ONLY = (
     "inherit", "mark", "obs_dir", "obs_stdout", "log_file", "quiet",
     "hbm_warn_factor", "metrics", "metrics_port", "alerts",
     "obs_rotate_mb",
+    # async-rim knobs: relocate/reorder host I/O without touching the
+    # trajectory (mirrors the harness config_hash unconditional skips).
+    # rounds_per_dispatch itself is NOT here — R>1 runs route solo
+    # (RunRegistry._is_solo) and R forks the hash lineage.
+    "async_writer", "dispatch_prefetch",
 )
 
 

@@ -186,10 +186,16 @@ class RunManager:
 
     @staticmethod
     def _is_solo(cfg: FedConfig) -> bool:
-        """Streamed cohorts and population meshes fall outside the batch
-        contract (validate_batch) — schedule them as solo single-lane
-        groups through the harness path instead of rejecting them."""
-        return cfg.cohort_size > 0 or cfg.pop_shards > 1
+        """Streamed cohorts, population meshes and multi-round dispatch
+        tiers fall outside the batch contract (validate_batch; the
+        BatchRunner owns its own per-round loop, which an R-round scan
+        cannot join) — schedule them as solo single-lane groups through
+        the harness path instead of rejecting them."""
+        return (
+            cfg.cohort_size > 0
+            or cfg.pop_shards > 1
+            or cfg.rounds_per_dispatch > 1
+        )
 
     def _open_obs(self, run_id: str, cfg: FedConfig, title: str):
         sink: obs_lib.EventSink = obs_lib.JsonlSink(

@@ -259,6 +259,8 @@ def test_config_hash_off_matches_predefense_formula():
         "forensics", "forensics_top", "flight_window",
         "metrics", "metrics_port", "alerts", "obs_rotate_mb",
         "sign_bits",
+        # output-only like the obs knobs: skipped unconditionally
+        "dispatch_prefetch", "async_writer",
     )
     items = sorted(
         (f.name, repr(getattr(cfg, f.name)))
@@ -270,6 +272,9 @@ def test_config_hash_off_matches_predefense_formula():
         # off condition (== 1, not service == "off"), so it is skipped
         # at this cfg's default exactly like the families above
         + ("pop_shards",)
+        # the multi-round dispatch tier too: R=1 hashes identically to
+        # pre-dispatch-tier builds (R>1 forks the lineage)
+        + ("rounds_per_dispatch",) + FedConfig._DISPATCH_KNOBS
     )
     legacy = hashlib.sha256(repr(items).encode()).hexdigest()[:8]
     assert harness.config_hash(cfg) == legacy

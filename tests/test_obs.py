@@ -658,3 +658,135 @@ def test_cli_profile_flags_parse():
     # every run's config)
     dflt = cli.config_from_args(p.parse_args([]))
     assert dflt.profile_rounds == "" and dflt.hbm_warn_factor == 2.0
+
+
+# ------------------------------------------- async host rim (writer)
+
+
+def test_async_sink_seq_ordering_under_concurrent_emit():
+    """Racing producers through AsyncSink must yield ONE gapless seq
+    order: the inner sink stamps on the single consumer thread, so
+    whatever interleaving won the queue IS the stream — and each
+    producer's own events stay FIFO within it."""
+    import threading
+
+    from byzantine_aircomp_tpu.obs.sinks import MemorySink
+    from byzantine_aircomp_tpu.obs.writer import AsyncSink, WriterThread
+
+    mem = MemorySink()
+    w = WriterThread()
+    sink = AsyncSink(mem, w)
+    n_threads, per = 4, 50
+
+    def produce(tid):
+        for i in range(per):
+            sink.emit({"kind": "x", "tid": tid, "i": i})
+
+    threads = [
+        threading.Thread(target=produce, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sink.close()
+    w.close()
+
+    assert [e["seq"] for e in mem.events] == list(range(n_threads * per))
+    for tid in range(n_threads):
+        mine = [e["i"] for e in mem.events if e["tid"] == tid]
+        assert mine == list(range(per))
+    assert w.error is None
+
+
+def test_writer_bounded_queue_backpressure():
+    """A full queue must BLOCK the producer (throttle, never drop): with
+    the consumer parked on a gate and maxsize=2 slots occupied, the next
+    submit stalls until the consumer frees a slot."""
+    import threading
+
+    from byzantine_aircomp_tpu.obs.writer import WriterThread
+
+    w = WriterThread(maxsize=2)
+    gate = threading.Event()
+    done = []
+    w.submit(gate.wait)            # consumer parks here
+    w.submit(lambda: done.append(1))
+    w.submit(lambda: done.append(2))   # queue now at its bound
+
+    blocked = threading.Thread(target=lambda: w.submit(lambda: done.append(3)))
+    blocked.start()
+    blocked.join(timeout=0.3)
+    assert blocked.is_alive(), "submit returned despite a full queue"
+    assert done == []
+
+    gate.set()
+    blocked.join(timeout=5)
+    assert not blocked.is_alive()
+    w.drain()
+    assert done == [1, 2, 3]
+    w.close()
+
+
+def test_writer_drain_on_run_end():
+    """drain() is the run-end contract: every task submitted so far has
+    landed when it returns, and a post-close submit degrades to running
+    inline instead of losing the write."""
+    from byzantine_aircomp_tpu.obs.writer import WriterThread
+
+    w = WriterThread()
+    done = []
+    for i in range(20):
+        w.submit(lambda i=i: done.append(i))
+    w.drain()
+    assert done == list(range(20))
+    w.close()
+    w.close()  # idempotent
+    w.submit(lambda: done.append("late"))
+    assert done[-1] == "late"
+
+
+def test_writer_sink_failure_degrades_without_deadlock(capsys):
+    """A raising task records the FIRST error and warns once; the
+    consumer keeps draining — a failing sink must never wedge or kill
+    the training loop (JsonlSink's degrade contract, lifted to the rim)."""
+    from byzantine_aircomp_tpu.obs.writer import WriterThread
+
+    w = WriterThread()
+    done = []
+
+    def boom():
+        raise OSError("disk on fire")
+
+    w.submit(boom)
+    w.submit(lambda: done.append(1))
+    w.submit(boom)
+    w.submit(lambda: done.append(2))
+    w.drain()
+    assert done == [1, 2]
+    assert isinstance(w.error, OSError) and "disk on fire" in str(w.error)
+    w.close()
+    err = capsys.readouterr().err
+    assert err.count("async writer task failed") == 1
+
+
+def test_multi_round_run_event_stream_complete_and_seq_monotonic(
+    tmp_path, synthetic_mnist
+):
+    """End to end: R=4 auto-enables the writer thread, and the drained
+    stream must be complete — gapless monotonic seq, every round
+    present, run_end closing the file (ISSUE: 'zero lost events')."""
+    from byzantine_aircomp_tpu.fed import harness
+    from byzantine_aircomp_tpu.obs import writer as writer_lib
+
+    cfg = _cfg(8, rounds_per_dispatch=4, obs_dir=str(tmp_path / "obs"))
+    assert writer_lib.resolve_async(cfg)  # auto -> on exactly when R > 1
+    harness.run(cfg, record_in_file=False)
+    events = _read_events(tmp_path / "obs", cfg)
+    for e in events:
+        obs_lib.validate_event(e)
+    assert [e["seq"] for e in events] == list(range(len(events)))
+    assert [e["round"] for e in events if e["kind"] == "round"] == list(
+        range(8)
+    )
+    assert events[-1]["kind"] == "run_end"

@@ -182,6 +182,11 @@ def test_sweep_forwards_every_shared_knob():
         "rollback_widen": 2.0,
         "rollback_max": 2,
         "pop_shards": 2,
+        "rounds_per_dispatch": 2,
+        "eval_interval": 2,
+        "dispatch_mode": "degraded",
+        "dispatch_prefetch": "on",
+        "async_writer": "on",
     }
     # the fault knobs require --fault and full participation
     # (config.validate), so they ride a second, separate sweep cell;
@@ -205,6 +210,11 @@ def test_sweep_forwards_every_shared_knob():
     # (config.validate), which the service and cohort cells each lack —
     # so it rides its own cell carrying the minimal joint context
     pop_dests = {"pop_shards"}
+    # the dispatch granularity knobs require --rounds-per-dispatch > 1,
+    # which in turn must divide the round budget (config.validate) — their
+    # cell bumps the budget to 2 so R=2 schedules one full dispatch
+    dispatch_dests = {"rounds_per_dispatch", "eval_interval",
+                      "dispatch_mode", "dispatch_prefetch", "async_writer"}
     probe = argparse.ArgumentParser()
     add_knob_flags(probe)
     flag_of = {
@@ -223,18 +233,21 @@ def test_sweep_forwards_every_shared_knob():
     orig = sweep_mod.run_sweep
     groups = (
         set(flag_of) - fault_dests - defense_dests - cohort_dests
-        - service_dests - sign_dests - pop_dests,
+        - service_dests - sign_dests - pop_dests - dispatch_dests,
         fault_dests,
         defense_dests,
         cohort_dests,
         service_dests,
         sign_dests,
         pop_dests,
+        dispatch_dests,
     )
     for group in groups:
         argv = list(base)
         if group is service_dests:
             argv += ["--defense", "monitor"]
+        if group is dispatch_dests:
+            argv[argv.index("--rounds") + 1] = "2"
         if group is sign_dests:
             argv[argv.index("mean")] = "signmv"
             argv += ["--sign-eta", "0.01"]

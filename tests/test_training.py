@@ -568,3 +568,181 @@ def test_resnet_config5_krum_rejects_both_attacks_identically():
     assert not np.array_equal(
         run("signflip", agg="mean"), run("gradascent", agg="mean")
     )
+
+
+# ----------------------------------- dispatch rim (--rounds-per-dispatch)
+
+
+def test_rounds_per_dispatch_validation():
+    def invalid(match, **kw):
+        with pytest.raises(ValueError, match=match):
+            make_cfg(**kw).validate()
+
+    invalid("must be >= 1", rounds_per_dispatch=0)
+    # fault-knob contract: dispatch knobs are inert at R=1
+    invalid("require --rounds-per-dispatch > 1", eval_interval=4)
+    invalid("require --rounds-per-dispatch > 1", dispatch_mode="degraded")
+    invalid("require --rounds-per-dispatch > 1", dispatch_prefetch="on")
+    # the budget must split into full R-round dispatches
+    invalid("divide the round budget", rounds=5, rounds_per_dispatch=2)
+    # evals only run between dispatches
+    invalid(
+        "multiple of", rounds=8, rounds_per_dispatch=4, eval_interval=2
+    )
+    make_cfg(rounds=8, rounds_per_dispatch=4, eval_interval=8).validate()
+    make_cfg(rounds=8, rounds_per_dispatch=4, dispatch_prefetch="on").validate()
+
+
+def test_rounds_per_dispatch_service_rollback_needs_degraded():
+    # the warm-rollback divergence guard can only fire at R-boundaries
+    # under a multi-round scan: exact mode refuses the combination, the
+    # documented degraded mode (or disarming the guard) accepts it
+    kw = dict(
+        honest_size=8, byz_size=0, agg="trimmed_mean", service="on",
+        population=24, rounds=8, rounds_per_dispatch=4,
+    )
+    with pytest.raises(ValueError, match="--dispatch-mode degraded"):
+        make_cfg(**kw).validate()
+    make_cfg(dispatch_mode="degraded", **kw).validate()
+    make_cfg(rollback="off", **kw).validate()
+
+
+def test_rounds_per_dispatch_identity_pins():
+    # R=1 golden pins: the dispatch rim must not fork the identity of any
+    # pre-existing run — checkpoints, records, and event streams all key
+    # on config_hash/run_title, so a silent fork would orphan them
+    from byzantine_aircomp_tpu.fed.harness import config_hash, run_title
+
+    assert config_hash(FedConfig()) == "3c9e1062"
+    assert run_title(FedConfig()) == "MLP_SGD_baseline_gm"
+    sp = FedConfig(
+        honest_size=28, byz_size=4, attack="signflip", agg="signmv",
+        sign_eta=0.01,
+    )
+    assert config_hash(sp) == "508f6f43"
+    assert run_title(sp) == "MLP_SGD_signflip_signmv_eta0.01"
+    # output-only knobs never fork the hash; R > 1 does (and is visible
+    # in the title)
+    assert config_hash(FedConfig(async_writer="on")) == "3c9e1062"
+    r4 = FedConfig(rounds=32, rounds_per_dispatch=4)
+    assert config_hash(r4) != config_hash(FedConfig(rounds=32))
+    assert run_title(r4).endswith("_rd4")
+
+
+def test_multi_round_driver_bit_equals_run_rounds_oracle():
+    # the R>1 production driver runs the SAME multi_round_fn program as
+    # run_rounds at the same dispatch lengths, so per-round metrics and
+    # final params must be BIT-equal — not merely close
+    cfg = make_cfg(
+        honest_size=8, byz_size=2, attack="classflip", agg="gm2",
+        rounds=4, rounds_per_dispatch=2,
+    )
+    a = FedTrainer(cfg, dataset=small_ds())
+    paths = a.train()
+    b = FedTrainer(cfg, dataset=small_ds())
+    oracle = []
+    for r0 in range(0, 4, 2):
+        oracle.extend(float(v) for v in np.asarray(b.run_rounds(r0, 2)))
+    assert paths["variencePath"] == oracle
+    np.testing.assert_array_equal(
+        np.asarray(a.flat_params), np.asarray(b.flat_params)
+    )
+
+
+def test_multi_round_rounds_per_sec_amortized():
+    # under R>1 every round of a dispatch reports the same amortized
+    # per-round rate (n / dispatch wall clock) — per-round timing does
+    # not exist inside a scan
+    cfg = make_cfg(rounds=4, rounds_per_dispatch=2)
+    paths = FedTrainer(cfg, dataset=small_ds()).train()
+    rps = paths["roundsPerSec"]
+    assert len(rps) == 4
+    assert rps[0] == rps[1] and rps[2] == rps[3]
+    assert all(v > 0 for v in rps)
+
+
+def test_dispatch_prefetch_parity():
+    # double-buffered dispatch: prefetching the next dispatch while the
+    # host folds the previous one must be bit-identical in everything
+    # except wall-clock timing
+    def run(prefetch):
+        cfg = make_cfg(
+            honest_size=8, byz_size=2, attack="classflip", agg="mean",
+            cohort_size=2, rounds=8, rounds_per_dispatch=2,
+            eval_interval=8, dispatch_prefetch=prefetch,
+        )
+        paths = FedTrainer(cfg, dataset=small_ds()).train()
+        paths.pop("roundsPerSec")
+        return paths
+
+    assert run("off") == run("on")
+
+
+def _dispatch_run_events(tmp_path, monkeypatch, **kw):
+    import json
+
+    import byzantine_aircomp_tpu.data.datasets as dl
+    from byzantine_aircomp_tpu.fed import harness
+    from byzantine_aircomp_tpu.obs import events_path
+
+    orig = dl.load
+    monkeypatch.setattr(
+        dl, "load",
+        lambda name, **k: orig(name, synthetic_train=600, synthetic_val=200),
+    )
+    # honest_size=6 keeps _make_trainer on the single-program layout under
+    # the conftest's 8 virtual devices (6 does not divide the mesh) — the
+    # same choice every other harness-level test makes
+    base = dict(
+        honest_size=6, byz_size=0, rounds=8, rounds_per_dispatch=4,
+        display_interval=4, batch_size=16, agg="mean", eval_train=False,
+        obs_dir=str(tmp_path / "obs"),
+    )
+    base.update(kw)
+    cfg = FedConfig(**base)
+    harness.run(cfg, record_in_file=False)
+    path = events_path(str(tmp_path / "obs"), harness.ckpt_title(cfg))
+    return [json.loads(l) for l in open(path)]
+
+
+def _assert_single_multi_round_lowering(events):
+    (ret,) = [e for e in events if e["kind"] == "retrace"]
+    assert ret["counts"].get("multi_round_fn") == 1, ret["counts"]
+    # the per-round fn must never have been dispatched at all: the R>1
+    # driver runs rounds exclusively through the scan program
+    assert ret["counts"].get("round_fn", 0) == 0, ret["counts"]
+    assert ret["steady_state_ok"]
+    rounds = [e["round"] for e in events if e["kind"] == "round"]
+    assert rounds == list(range(8))
+
+
+def test_multi_round_dispatch_single_lowering_resident(tmp_path, monkeypatch):
+    """CI retrace-gate member: --rounds-per-dispatch 4 on the resident
+    path must lower multi_round_fn exactly once across both dispatches —
+    a per-dispatch recompile would silently re-pay the compile the R
+    knob exists to amortize."""
+    _assert_single_multi_round_lowering(
+        _dispatch_run_events(tmp_path, monkeypatch)
+    )
+
+
+def test_multi_round_dispatch_single_lowering_streamed(tmp_path, monkeypatch):
+    """CI retrace-gate member: the cohort-streamed round under R=4 — the
+    in-jit cohort scan nests inside the dispatch scan and must not add a
+    lowering."""
+    _assert_single_multi_round_lowering(
+        _dispatch_run_events(tmp_path, monkeypatch, cohort_size=2)
+    )
+
+
+def test_multi_round_dispatch_single_lowering_service(tmp_path, monkeypatch):
+    """CI retrace-gate member: service rounds (churn + deadline masks)
+    under R=4 stay shape-stable across dispatches.  rollback=off keeps
+    exact mode legal; the divergence guard's R-boundary behavior is
+    covered by the degraded-mode validation contract."""
+    _assert_single_multi_round_lowering(
+        _dispatch_run_events(
+            tmp_path, monkeypatch, service="on", population=24,
+            agg="trimmed_mean", rollback="off",
+        )
+    )
