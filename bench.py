@@ -45,7 +45,10 @@ cohort-streamed K-sweep (``fed/train.py`` ``--cohort-size`` path) that
 emits one ``stream_ksweep`` row per K with rounds/sec AND the peak-bytes
 columns (measured watermark + the ``obs/hbm.py`` streamed/resident
 models), one JSON line per K on stdout.  Rows land in the ledger via
-``BENCH_LEDGER`` or ``analysis/perf_gate.py --append``.
+``BENCH_LEDGER`` or ``analysis/perf_gate.py --append``.  Further modes:
+``BENCH_SIGNPACK=1`` (packed sign-channel rows), ``BENCH_MULTIROUND=1``
+(dispatch-rim sweep), ``BENCH_HETERO=1`` (heterogeneity sweep —
+ResNet18 on ``emnist_hard`` across Dirichlet levels).
 """
 
 from __future__ import annotations
@@ -653,6 +656,122 @@ def run_multiround_bench() -> None:
 
 
 # --------------------------------------------------------------------------
+# hetero mode: heterogeneity sweep rows (BENCH_HETERO=1)
+# --------------------------------------------------------------------------
+
+def run_hetero_bench() -> None:
+    """Heterogeneity sweep: one row per Dirichlet level.
+
+    Runs the full production driver on a harder-than-mnist regime — the
+    ``emnist_hard`` synthetic (62 classes, EMNIST moments, ~0.91 Bayes
+    ceiling) under ``ResNet18`` — at each level in
+    ``BENCH_HETERO_ALPHAS`` (default ``iid,0.3,0.1``; ``iid`` is the
+    contiguous partition, floats are ``--partition dirichlet`` levels),
+    and emits one ``hetero_train_rps_<label>`` row per level.  The level
+    is baked into the metric name so same-level rows regression-test
+    against each other in the ledger, and carried as ``dirichlet_alpha``
+    / ``size_skew`` columns so a row stays self-describing.  ``val_acc``
+    rides along: non-IID rows SHOULD show the accuracy drag the tuner's
+    heterogeneity story is about — a non-IID row matching the IID one
+    means the partition never took effect.
+
+    The reported value is the steady-state per-round rate read off the
+    driver's event stream (the multiround idiom): ``rounds - 1`` divided
+    by the gap between the first and last ``round`` event, which excises
+    compile but keeps eval cadence and the host rim.  Partitioning is
+    host-side setup, so the rate should be flat across levels — a level
+    that moves the rate is itself a finding.
+
+    Env knobs: ``BENCH_HETERO_K``/``_B``/``_AGG``/``_ROUNDS``/
+    ``_ALPHAS``/``_MODEL``/``_WIDTH``/``_DATASET``/``_TRAIN``/``_VAL``/
+    ``_BATCH``/``_SKEW`` (a ``zipf:<s>`` spec composes quantity skew
+    with the label skew on every level).
+    """
+    k = int(os.environ.get("BENCH_HETERO_K", "16"))
+    b = int(os.environ.get("BENCH_HETERO_B", "3"))
+    agg = os.environ.get("BENCH_HETERO_AGG", "mean")
+    rounds = int(os.environ.get("BENCH_HETERO_ROUNDS", "8"))
+    model = os.environ.get("BENCH_HETERO_MODEL", "ResNet18")
+    width = int(os.environ.get("BENCH_HETERO_WIDTH", "8"))
+    dataset = os.environ.get("BENCH_HETERO_DATASET", "emnist_hard")
+    n_train = int(os.environ.get("BENCH_HETERO_TRAIN", "2048"))
+    n_val = int(os.environ.get("BENCH_HETERO_VAL", "512"))
+    batch = int(os.environ.get("BENCH_HETERO_BATCH", "8"))
+    skew = os.environ.get("BENCH_HETERO_SKEW", "none")
+    labels = [
+        s.strip()
+        for s in os.environ.get("BENCH_HETERO_ALPHAS", "iid,0.3,0.1").split(",")
+        if s.strip()
+    ]
+
+    import jax
+
+    from byzantine_aircomp_tpu import obs as obs_lib
+    from byzantine_aircomp_tpu.data import datasets as data_lib
+    from byzantine_aircomp_tpu.fed.config import FedConfig
+    from byzantine_aircomp_tpu.fed.train import FedTrainer
+    from byzantine_aircomp_tpu.obs.sinks import MemorySink
+
+    platform = jax.default_backend()
+    log(
+        f"hetero: backend={platform} K={k} B={b} agg={agg} model={model} "
+        f"dataset={dataset} rounds={rounds} levels={labels} skew={skew}"
+    )
+    for label in labels:
+        cfg_kw = dict(
+            honest_size=k - b,
+            byz_size=b,
+            attack="signflip",
+            agg=agg,
+            rounds=rounds,
+            display_interval=1,
+            batch_size=batch,
+            model=model,
+            resnet_width=width,
+            size_skew=skew,
+            eval_train=False,
+        )
+        if label != "iid":
+            cfg_kw["partition"] = "dirichlet"
+            cfg_kw["dirichlet_alpha"] = float(label)
+        cfg = FedConfig(**cfg_kw)
+        ds = data_lib.load(
+            dataset, synthetic_train=n_train, synthetic_val=n_val
+        )
+        trainer = FedTrainer(cfg, dataset=ds)
+        sink = MemorySink()
+        paths = trainer.train(obs=obs_lib.Observability(sink))
+
+        ts = [e["ts"] for e in sink.by_kind("round")]
+        steady = max(ts[-1] - ts[0], 1e-9)
+        rps = (rounds - 1) / steady
+        val_acc = paths["valAccPath"][-1]
+        metric_label = "iid" if label == "iid" else f"a{label}"
+
+        row = make_bench_row(
+            rps,
+            platform=platform,
+            timed_rounds=rounds - 1,
+            val_acc=val_acc,
+            params={
+                "k": k, "b": b, "agg": agg, "attack": "signflip",
+                "dataset": dataset, "model": model,
+                "metric": f"hetero_train_rps_{metric_label}",
+            },
+        )
+        row["d"] = int(trainer.dim)
+        row["dirichlet_alpha"] = None if label == "iid" else float(label)
+        if skew != "none":
+            row["size_skew"] = skew
+        log(
+            f"hetero: {metric_label} steady {rps:.3f} rounds/sec "
+            f"({rounds - 1} rounds in {steady:.3f}s past compile, "
+            f"val_acc={val_acc:.4f})"
+        )
+        emit_row(row)
+
+
+# --------------------------------------------------------------------------
 # parent: probe + dispatch (never initializes a backend, cannot hang)
 # --------------------------------------------------------------------------
 
@@ -747,6 +866,9 @@ def main() -> None:
         return
     if os.environ.get("BENCH_MULTIROUND"):
         run_multiround_bench()
+        return
+    if os.environ.get("BENCH_HETERO"):
+        run_hetero_bench()
         return
 
     def _secs(name: str, default: str) -> float | None:

@@ -32,6 +32,25 @@ Semantics mirrored from the trainer (fed/train.py):
   explicitly — a dash in the latency column would read as "ran and went
   undetected" when the cell never had a stack-level signature to find.
 
+``--alphas`` grows the matrix into the heterogeneity x attack x defense
+CUBE: each requested Dirichlet level (``iid`` or a float alpha) injects
+per-client drift into the honest stack — every client's class mixture
+``pi_i ~ Dir(alpha * 1)`` blends a fixed set of class gradient
+directions, and the client's drift is the mismatch between that blend
+and the uniform mixture, with a per-iteration fluctuating magnitude so
+the per-client EMA baseline cannot simply absorb it.  ``--tuned
+label=path`` feeds the committed ``docs/tuned_defense_*.json`` artifacts
+back in: those levels run BOTH the default detector/policy constants and
+the tuned ones, so the committed cube shows exactly where the IID-tuned
+defaults start paging on honest non-IID clients and the tuned ladder
+does not:
+
+    python -m byzantine_aircomp_tpu.analysis.adaptive_matrix \
+        --attacks signflip,duty_cycle --modes adaptive \
+        --alphas iid,0.3,0.1 \
+        --tuned 0.3=docs/tuned_defense_a0.3.json,0.1=docs/tuned_defense_a0.1.json \
+        --json docs/break_matrix_hetero.json
+
 Output: one JSON line per cell on stdout (kind ``adaptive_cell``), a
 markdown table per (mode, ladder) on stderr, optionally an atomic pickle
 of the grid (``--out``) and a canonical timestamp-free JSON dump
@@ -59,6 +78,7 @@ from .. import defense as defense_lib
 from .. import obs as obs_lib
 from ..ops import attacks as attack_lib
 from ..registry import ATTACKS
+from ..serve.batch import _DETECTOR_KNOBS, _INT_KNOBS, _POLICY_KNOBS
 from ..utils import io as io_lib
 
 K, B, D = 16, 3, 24
@@ -67,6 +87,7 @@ HONEST = K - B
 MODES = ("off", "monitor", "adaptive")
 
 Cell = Tuple[str, str, str]  # (attack, mode, ladder)
+CubeCell = Tuple[str, str, str, str, str]  # ... + (alpha label, constants)
 
 
 def honest_stack(key: Optional[jax.Array] = None):
@@ -81,6 +102,96 @@ def honest_stack(key: Optional[jax.Array] = None):
         jax.random.fold_in(key, 2), (K, D)
     )
     return w.astype(jnp.float32), base.astype(jnp.float32)
+
+
+def parse_alphas(spec: str) -> List[Tuple[str, Optional[float]]]:
+    """``--alphas`` tokens -> ``[(label, alpha)]``; the literal ``iid``
+    means no heterogeneity (``alpha=None``), anything else is a positive
+    Dirichlet concentration (lower = more heterogeneous)."""
+    out: List[Tuple[str, Optional[float]]] = []
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok == "iid":
+            out.append((tok, None))
+            continue
+        a = float(tok)
+        if a <= 0:
+            raise ValueError(f"--alphas entry must be positive, got {tok!r}")
+        out.append((tok, a))
+    if not out:
+        raise ValueError("--alphas parsed to an empty list")
+    return out
+
+
+def make_hetero(
+    alpha: Optional[float],
+    key: jax.Array,
+    *,
+    classes: int = 8,
+    scale: float = 5e-3,
+) -> Optional[jnp.ndarray]:
+    """Per-client heterogeneity drift for one Dirichlet level.
+
+    Client ``i``'s class mixture ``pi_i ~ Dir(alpha * 1_classes)`` blends
+    ``classes`` fixed per-class gradient directions; the client's drift is
+    the mismatch between that blend and the uniform mixture — the exact
+    dispersion label skew induces on honest updates (a client training
+    mostly on class c pulls toward c's direction).  Low alpha makes
+    ``pi_i`` near-one-hot, so drifts approach the full class-direction
+    scale; high alpha collapses them toward zero, and ``alpha=None`` (IID)
+    returns ``None``.  The caller applies a per-iteration fluctuating
+    magnitude on top (see the simulate loops): a CONSTANT per-client
+    offset would be absorbed by the per-client EMA baseline within the
+    warmup, which is precisely why IID-tuned constants look fine on
+    constant skew and page on the real, fluctuating kind."""
+    if alpha is None:
+        return None
+    conc = jnp.full((classes,), float(alpha), jnp.float32)
+    gam = jax.random.gamma(jax.random.fold_in(key, 7), conc, (K, classes))
+    pi = gam / jnp.sum(gam, axis=1, keepdims=True)
+    dirs = scale * jax.random.normal(
+        jax.random.fold_in(key, 8), (classes, D)
+    )
+    u = pi @ dirs - jnp.mean(dirs, axis=0)[None, :]
+    return u.astype(jnp.float32)
+
+
+def _hetero_stack(w, hetero, key0, t):
+    """The heterogeneous honest stack at iteration ``t``: drift directions
+    scaled by a per-(client, iteration) fluctuating magnitude (half-normal
+    around 1) so the deviation survives the per-client EMA baseline.  Key
+    stream ``400 + t`` — disjoint from the 100/200/300 streams, identical
+    between the eager and batched paths."""
+    if hetero is None:
+        return w
+    m = 1.0 + 0.5 * jnp.abs(
+        jax.random.normal(jax.random.fold_in(key0, 400 + t), (K, 1))
+    )
+    return w + m * hetero
+
+
+def tuned_defense_params(
+    params: Dict[str, float], n_rungs: int
+) -> Tuple[defense_lib.DetectorParams, defense_lib.PolicyParams]:
+    """``(DetectorParams, PolicyParams)`` from a tune artifact's winning
+    constants (``docs/tuned_defense_*.json``, key ``tuned.params``) via
+    the authoritative knob->field maps in ``serve/batch.py`` — the same
+    translation the vmapped lane engine applies, so the cube runs exactly
+    what the tuner scored."""
+    def cast(k):
+        return int(params[k]) if k in _INT_KNOBS else float(params[k])
+
+    det = defense_lib.DetectorParams(**{
+        field: cast(knob)
+        for knob, field in _DETECTOR_KNOBS.items() if knob in params
+    })
+    pol = defense_lib.PolicyParams(n_rungs=n_rungs, **{
+        field: cast(knob)
+        for knob, field in _POLICY_KNOBS.items() if knob in params
+    })
+    return det, pol
 
 
 def _attacked(spec, w, base, key, defense=None):
@@ -109,6 +220,7 @@ def simulate_cell(
     det: Optional[defense_lib.DetectorParams] = None,
     pol: Optional[defense_lib.PolicyParams] = None,
     seed: int = 0,
+    hetero: Optional[jnp.ndarray] = None,
 ) -> Dict[str, object]:
     """One (attack, mode) cell: the defense loop run eagerly for ``iters``
     iterations with the attack active on ``[onset, stop)``.
@@ -189,7 +301,7 @@ def simulate_cell(
     for t in range(iters):
         kt = jax.random.fold_in(key0, 100 + t)
         w = base[None, :] + 1e-3 * jax.random.normal(kt, (K, D))
-        w = w.astype(jnp.float32)
+        w = _hetero_stack(w.astype(jnp.float32), hetero, key0, t)
         active = onset <= t and (stop is None or t < stop)
         if active:
             d_view = None
@@ -282,6 +394,7 @@ def simulate_cells_batched(
     det: Optional[defense_lib.DetectorParams] = None,
     pol: Optional[defense_lib.PolicyParams] = None,
     seed: int = 0,
+    hetero: Optional[jnp.ndarray] = None,
 ) -> Dict[str, Dict[str, object]]:
     """Every requested mode of one (attack, ladder) family from ONE
     jitted ``lax.scan`` — the ``--batched`` kernel.
@@ -343,7 +456,7 @@ def simulate_cells_batched(
         d_state, p_state = carry
         kt = jax.random.fold_in(key0, 100 + t)
         w = base[None, :] + 1e-3 * jax.random.normal(kt, (K, D))
-        w = w.astype(jnp.float32)
+        w = _hetero_stack(w.astype(jnp.float32), hetero, key0, t)
         if stop is None:
             active = t >= onset
         else:
@@ -531,6 +644,71 @@ def run_matrix(
     return grid
 
 
+def run_cube(
+    attacks: List[str],
+    modes: List[str],
+    ladders: List[Tuple[str, ...]],
+    alphas: List[Tuple[str, Optional[float]]],
+    tuned: Dict[str, Dict[str, float]],
+    *,
+    hetero_scale: float = 5e-3,
+    hetero_classes: int = 8,
+    log=lambda s: print(s, file=sys.stderr, flush=True),
+    on_cell=None,
+    batched: bool = False,
+    **sim_kw,
+) -> Dict[CubeCell, Dict[str, object]]:
+    """The heterogeneity x attack x defense cube: the (attack, mode,
+    ladder) matrix swept over Dirichlet levels and defense-constant
+    variants.  Every level runs the ``default`` constants; levels named
+    in ``tuned`` (label -> artifact ``tuned.params`` dict) additionally
+    run the ``tuned`` constants, so one committed dump answers "where do
+    the IID defaults start paging on honest heterogeneity, and does the
+    tuned ladder stop it".  Keys are 5-tuples
+    ``(attack, mode, ladder, "alpha=<label>", "default"|"tuned")``; cells
+    are a pure function of the flags + seed, like the plain matrix."""
+    unknown = sorted(set(tuned) - {lab for lab, _ in alphas})
+    if unknown:
+        raise ValueError(
+            f"--tuned labels {unknown} not in the --alphas sweep "
+            f"({[lab for lab, _ in alphas]})"
+        )
+    n_rungs = {len(lad) for lad in ladders}
+    if len(n_rungs) != 1:
+        raise ValueError(
+            f"ladder variants must share a length: {sorted(n_rungs)}"
+        )
+    n_rungs = n_rungs.pop()
+    key = jax.random.PRNGKey(int(sim_kw.get("seed", 0)))
+    grid: Dict[CubeCell, Dict[str, object]] = {}
+    for label, alpha in alphas:
+        hetero = make_hetero(
+            alpha, key, classes=hetero_classes, scale=hetero_scale
+        )
+        variants = [("default", sim_kw.get("det"), sim_kw.get("pol"))]
+        if label in tuned:
+            det_t, pol_t = tuned_defense_params(tuned[label], n_rungs)
+            variants.append(("tuned", det_t, pol_t))
+        for vname, det_v, pol_v in variants:
+            log(
+                f"[adaptive_matrix] cube slice alpha={label} "
+                f"constants={vname}"
+            )
+            sub_kw = dict(sim_kw, det=det_v, pol=pol_v, hetero=hetero)
+            sub = run_matrix(
+                attacks, modes, ladders=ladders, log=log, batched=batched,
+                on_cell=(
+                    None if on_cell is None else
+                    lambda a, m, l, c, _lab=label, _v=vname:
+                        on_cell(a, m, l, _lab, _v, c)
+                ),
+                **sub_kw,
+            )
+            for (a, m, lad), cell in sub.items():
+                grid[(a, m, lad, f"alpha={label}", vname)] = cell
+    return grid
+
+
 def markdown_table(grid: Dict[Cell, Dict[str, object]]) -> str:
     """One ``attack x metric`` table per (mode, ladder); undetected cells
     show ``-`` in the latency column so a silent attack can't read as
@@ -631,6 +809,22 @@ def main(argv=None) -> None:
                          "for before/after comparisons)")
     ap.add_argument("--leak", type=float, default=0.005,
                     help="policy budget_leak")
+    ap.add_argument("--alphas", default=None,
+                    help="comma list of Dirichlet levels ('iid' or a "
+                         "positive float); sweeps the heterogeneity axis "
+                         "— the grid becomes the hetero x attack x "
+                         "defense cube with 5-part keys")
+    ap.add_argument("--hetero-scale", type=float, default=5e-3,
+                    help="class gradient-direction scale for the "
+                         "heterogeneity drift (--alphas)")
+    ap.add_argument("--hetero-classes", type=int, default=8,
+                    help="number of pseudo-classes behind the Dirichlet "
+                         "mixture (--alphas)")
+    ap.add_argument("--tuned", default=None,
+                    help="comma list label=path of tune artifacts "
+                         "(docs/tuned_defense_*.json); those --alphas "
+                         "levels also run the artifact's tuned constants "
+                         "as a 'tuned' variant")
     ap.add_argument("--out", default=None, help="pickle the grid here")
     ap.add_argument("--json", default=None,
                     help="canonical sorted timestamp-free JSON dump here "
@@ -656,6 +850,12 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
     if (args.expect_speedup is not None or args.perf_row) and not args.batched:
         ap.error("--expect-speedup/--perf-row require --batched")
+    if args.tuned and not args.alphas:
+        ap.error("--tuned requires --alphas (it names levels of the sweep)")
+    if args.alphas and (args.assert_smoke or args.expect_speedup
+                        or args.perf_row):
+        ap.error("--assert-smoke/--expect-speedup/--perf-row gate the "
+                 "plain matrix; run them without --alphas")
 
     attacks = (
         [a for a in args.attacks.split(",") if a]
@@ -693,21 +893,51 @@ def main(argv=None) -> None:
         pol=pol,
         seed=args.seed,
     )
+    alphas = parse_alphas(args.alphas) if args.alphas else None
+    tuned: Dict[str, Dict[str, float]] = {}
+    if args.tuned:
+        for item in args.tuned.split(","):
+            if not item:
+                continue
+            label, _, path = item.partition("=")
+            if not path:
+                ap.error(f"--tuned entry {item!r} is not label=path")
+            with open(path) as f:
+                artifact = json.load(f)
+            tuned[label.strip()] = artifact["tuned"]["params"]
     t0 = time.perf_counter()
     try:
-        grid = run_matrix(
-            attacks,
-            modes,
-            ladders=ladders,
-            batched=args.batched,
-            on_cell=lambda attack, mode, lad, cell: sink.emit(
-                obs_lib.make_event(
-                    "adaptive_cell", attack=attack, mode=mode,
-                    ladder=lad, **cell
-                )
-            ),
-            **sim_kw,
-        )
+        if alphas is not None:
+            grid = run_cube(
+                attacks,
+                modes,
+                ladders,
+                alphas,
+                tuned,
+                hetero_scale=args.hetero_scale,
+                hetero_classes=args.hetero_classes,
+                batched=args.batched,
+                on_cell=lambda attack, mode, lad, alabel, var, cell:
+                    sink.emit(obs_lib.make_event(
+                        "adaptive_cell", attack=attack, mode=mode,
+                        ladder=lad, alpha=alabel, constants=var, **cell
+                    )),
+                **sim_kw,
+            )
+        else:
+            grid = run_matrix(
+                attacks,
+                modes,
+                ladders=ladders,
+                batched=args.batched,
+                on_cell=lambda attack, mode, lad, cell: sink.emit(
+                    obs_lib.make_event(
+                        "adaptive_cell", attack=attack, mode=mode,
+                        ladder=lad, **cell
+                    )
+                ),
+                **sim_kw,
+            )
     finally:
         sink.close()
     primary_secs = time.perf_counter() - t0
@@ -747,7 +977,24 @@ def main(argv=None) -> None:
                 f"{len(drift)} integer column(s): {drift[:5]}",
                 file=sys.stderr,
             )
-    print(markdown_table(grid), file=sys.stderr, flush=True)
+    if alphas is not None:
+        # one table block per cube slice, in sweep order
+        for label, _alpha in alphas:
+            for var in ("default", "tuned"):
+                sub = {
+                    (a, m, lad): c
+                    for (a, m, lad, alab, v), c in grid.items()
+                    if alab == f"alpha={label}" and v == var
+                }
+                if not sub:
+                    continue
+                print(
+                    f"\n## alpha={label} | constants: {var}\n",
+                    file=sys.stderr,
+                )
+                print(markdown_table(sub), file=sys.stderr, flush=True)
+    else:
+        print(markdown_table(grid), file=sys.stderr, flush=True)
     if args.out:
         io_lib.atomic_pickle(
             args.out, {"|".join(k): c for k, c in grid.items()}

@@ -54,6 +54,19 @@ def ground_truth(events: List[dict]) -> Optional[Dict[str, object]]:
     population = start.get("population")
     if k is None:
         return None
+    explicit = start.get("byz_ids")
+    if explicit is not None:
+        # the harness emits the trainer's actual mask; trust it over any
+        # layout re-derivation — Dirichlet/size-skewed partitions are free
+        # to place byzantine clients off the last-byz-slots assumption
+        ids = {int(i) for i in explicit}
+        assert len(ids) == byz, (
+            f"run_start byz_ids carries {len(ids)} ids but byz={byz}; "
+            f"the stream header is inconsistent"
+        )
+        universe = population if population else k
+        return {"byz_ids": ids, "universe": universe, "k": k, "byz": byz,
+                "population": population}
     if population:
         # service mode: ids are population shards; the harness assigns the
         # byzantine populations the top of the id space (fed/service.py).

@@ -258,6 +258,7 @@ def main(argv=None) -> None:
         eval_train=False,
         partition=args.partition,
         dirichlet_alpha=args.dirichlet_alpha,
+        size_skew=args.size_skew,
         participation=args.participation,
         bucket_size=args.bucket_size,
         client_momentum=args.client_momentum,

@@ -39,6 +39,11 @@ def add_knob_flags(p) -> None:
     p.add_argument("--dirichlet-alpha", type=float, default=0.3,
                    help="Dirichlet concentration for --partition dirichlet "
                         "(smaller = more label skew)")
+    p.add_argument("--size-skew", type=str, default="none",
+                   help="per-client quantity skew: 'zipf:<s>' re-cuts the "
+                        "(possibly Dirichlet-permuted) sample stream into "
+                        "Zipf(s)-proportioned shard sizes (composes with "
+                        "label skew; zipf:0 = the equal cut)")
     p.add_argument("--participation", type=float, default=1.0,
                    help="fraction of clients active per iteration "
                         "(stratified honest/Byzantine draw; 1.0 = all, "
@@ -238,6 +243,7 @@ ARG_TO_FIELD = {
     "stack_dtype": ("stack_dtype", None),
     "partition": ("partition", None),
     "dirichlet_alpha": ("dirichlet_alpha", None),
+    "size_skew": ("size_skew", None),
     "participation": ("participation", None),
     "bucket_size": ("bucket_size", None),
     "cohort_size": ("cohort_size", None),
@@ -695,6 +701,10 @@ def main(argv: Optional[Sequence[str]] = None):
         from .serve.edge import main as edge_main
 
         return edge_main(list(argv[1:]))
+    if argv and argv[0] == "tune":
+        from .tune.tuner import main as tune_main
+
+        return tune_main(list(argv[1:]))
     args = build_parser().parse_args(argv)
     if (
         args.multihost

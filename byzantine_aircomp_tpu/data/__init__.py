@@ -3,5 +3,7 @@ from .datasets import (  # noqa: F401
     Dataset,
     contiguous_shards,
     load,
+    parse_size_skew,
     sample_client_batch_indices,
+    zipf_shards,
 )

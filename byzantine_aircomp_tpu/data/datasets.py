@@ -265,6 +265,24 @@ def emnist(synthetic_train: int = 100000, synthetic_val: int = 16000, **_) -> Da
     )
 
 
+@DATASETS.register("emnist_hard")
+def emnist_hard(
+    synthetic_train: int = 100000, synthetic_val: int = 16000, **_
+) -> Dataset:
+    """Always-synthetic EMNIST-shaped set (62 classes) with a pinned
+    accuracy ceiling — the mnist_hard idiom at byclass width.
+
+    Uniform label resampling with p=0.09 over 62 classes pins the
+    Bayes-optimal val accuracy at ``1 - p*61/62 = 0.911``, so the
+    heterogeneity bench rows (``BENCH_HETERO``) measure a workload that
+    cannot sit at ceiling regardless of the Dirichlet alpha.  Never loads
+    from disk — bench rows stay reproducible on any machine."""
+    return _synthetic(
+        "emnist_hard", synthetic_train, synthetic_val, 62, (28, 28),
+        EMNIST_STATS, label_noise=0.09,
+    )
+
+
 def _read_cifar_bin(path: str):
     """CIFAR-10 binary batch -> (images [N,3,32,32] u8, labels [N] u8).
 
@@ -454,6 +472,58 @@ def dirichlet_shards(
     return perm, ClientSharding(
         offsets=offsets.astype(np.int32), sizes=sizes
     )
+
+
+def zipf_shards(n: int, k: int, s: float) -> ClientSharding:
+    """Quantity-skewed contiguous cut: client i (1-based) owns a share
+    proportional to ``i^-s`` of the n-sample stream, boundaries placed at
+    ``pieces[i] = floor(n * W_i / W_k)`` with ``W_i = sum_{j<=i} j^-s``.
+
+    At ``s=0`` every weight is 1, ``W_i = i`` and the boundary formula
+    degenerates to ``floor(i*n/k)`` — BIT-IDENTICAL to
+    :func:`contiguous_shards`, which is the parity contract the
+    ``--size-skew`` knob's tests pin.  Because the cut re-slices whatever
+    index stream the caller already laid out (identity or the
+    Dirichlet-permuted order), quantity skew composes with label skew
+    without touching the on-device sampler.
+
+    Every client is guaranteed >= 1 sample (requires ``n >= k``): a
+    forward pass bumps collapsed boundaries, a backward clamp keeps the
+    tail inside ``n``.  At ``s=0`` with ``n >= k`` the boundaries are
+    already strictly increasing, so the repair is a no-op there and
+    parity is preserved."""
+    if n < k:
+        raise ValueError(
+            f"zipf_shards needs >= 1 sample per client (n={n} < k={k})"
+        )
+    if s < 0:
+        raise ValueError(f"zipf exponent must be >= 0, got {s}")
+    w = np.arange(1, k + 1, dtype=np.float64) ** (-float(s))
+    cum = np.concatenate([[0.0], np.cumsum(w)])
+    pieces = np.floor(n * cum / cum[-1]).astype(np.int64)
+    pieces[-1] = n  # guard against float round-down at the tail
+    for i in range(1, k + 1):  # >= 1 sample per client
+        if pieces[i] <= pieces[i - 1]:
+            pieces[i] = pieces[i - 1] + 1
+    for i in range(k, 0, -1):  # keep the bumped tail inside n
+        if pieces[i] > n - (k - i):
+            pieces[i] = n - (k - i)
+    return ClientSharding(
+        offsets=pieces[:-1].astype(np.int32),
+        sizes=np.diff(pieces).astype(np.int32),
+    )
+
+
+def parse_size_skew(spec: str) -> Optional[float]:
+    """``"none"`` -> None, ``"zipf:<s>"`` -> s (validated s >= 0)."""
+    if spec == "none":
+        return None
+    if not spec.startswith("zipf:"):
+        raise ValueError(f"size_skew must be 'none' or 'zipf:<s>', got {spec!r}")
+    s = float(spec.split(":", 1)[1])
+    if s < 0:
+        raise ValueError(f"zipf exponent must be >= 0, got {s}")
+    return s
 
 
 def sample_client_batch_indices(

@@ -203,6 +203,12 @@ class FedConfig:
     # is derived from (seed, alpha); smaller alpha = more skew
     partition: str = "contiguous"
     dirichlet_alpha: float = 0.3
+    # quantity skew orthogonal to the label skew above: "none" keeps the
+    # equal-size cut, "zipf:<s>" re-cuts the (possibly Dirichlet-permuted)
+    # contiguous index stream into Zipf(s)-proportioned pieces — client i
+    # owns ~ i^-s of the samples, so size skew composes with label skew.
+    # s=0 is the exact equal cut (bit-identical boundaries)
+    size_skew: str = "none"
     # partial participation (the FedAvg setting; the reference activates
     # every client every iteration): each global iteration runs a
     # STRATIFIED sample of half-up(participation * honest_size) honest and
@@ -534,6 +540,21 @@ class FedConfig:
         assert self.dirichlet_alpha > 0, (
             f"dirichlet_alpha must be positive, got {self.dirichlet_alpha}"
         )
+        if self.size_skew != "none":
+            assert self.size_skew.startswith("zipf:"), (
+                f"size_skew must be 'none' or 'zipf:<s>', "
+                f"got {self.size_skew!r}"
+            )
+            try:
+                s = float(self.size_skew.split(":", 1)[1])
+            except ValueError:
+                raise AssertionError(
+                    f"size_skew exponent must be a float, "
+                    f"got {self.size_skew!r}"
+                )
+            assert s >= 0, (
+                f"size_skew exponent must be >= 0, got {s}"
+            )
         assert self.stack_dtype in ("f32", "bf16"), (
             f"stack_dtype must be 'f32' or 'bf16', got {self.stack_dtype!r}"
         )

@@ -134,6 +134,10 @@ def run_title(cfg: FedConfig) -> str:
     # it on checkpoints/pickles (same hazard class as the cclip tau note)
     if cfg.partition == "dirichlet":
         title += f"_dir{cfg.dirichlet_alpha}"
+    if cfg.size_skew != "none":
+        # quantity-skewed shard sizes change every client's sample stream,
+        # so skewed runs must never alias the equal-cut trajectory
+        title += f"_skew{cfg.size_skew.replace(':', '')}"
     if cfg.participation < 1.0:
         title += f"_part{cfg.participation}"
     if cfg.bucket_size > 1:
@@ -260,6 +264,10 @@ def config_hash(cfg: FedConfig) -> str:
         # keyed on service — pop_shards > 1 always forks (the shard fold
         # reassociates float sums), even though it requires --service on
         skip = skip + ("pop_shards",)
+    if cfg.size_skew == "none":
+        # size-skew continuity: the default equal cut must hash
+        # identically to builds that predate the size_skew field
+        skip = skip + ("size_skew",)
     if cfg.sign_bits == 32:
         # same continuity contract: a full-width (legacy) sign channel
         # must hash identically to builds that predate the sign_bits
@@ -740,6 +748,17 @@ def _run_inner(
         start_round=start_round,
         k=cfg.node_size,
         byz=cfg.byz_size,
+        # the authoritative byzantine id set, read straight off the
+        # trainer's mask: the audit pipeline must not re-derive it from a
+        # layout assumption (last-byz-slots) that Dirichlet/skewed
+        # partitions are free to break.  Service mode keeps the
+        # population-range derivation (client_flag ids are population ids
+        # there, and the id space is too large to list)
+        byz_ids=(
+            None if cfg.service == "on"
+            or getattr(trainer, "byz_mask", None) is None
+            else [int(i) for i in np.flatnonzero(np.asarray(trainer.byz_mask))]
+        ),
         dim=trainer.dim,
         agg=cfg.agg,
         attack=cfg.attack,

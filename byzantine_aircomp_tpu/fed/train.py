@@ -152,6 +152,15 @@ class FedTrainer:
             sharding = data_lib.contiguous_shards(
                 len(y_host), cfg.node_size
             )
+        # quantity skew composes with label skew by re-cutting whatever
+        # index stream the partition above laid out (identity or the
+        # Dirichlet-permuted order) into Zipf-proportioned contiguous
+        # pieces; zipf:0 reproduces the equal cut bit-identically
+        skew_s = data_lib.parse_size_skew(cfg.size_skew)
+        if skew_s is not None:
+            sharding = data_lib.zipf_shards(
+                len(y_host), cfg.node_size, skew_s
+            )
         raw = self.dataset.x_train_raw
         if raw is not None and perm is not None:
             raw = raw[perm]

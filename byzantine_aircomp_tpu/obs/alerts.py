@@ -155,6 +155,16 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
      "metric": "aircomp_edge_quarantines_total",
      "window": 8, "reduce": "delta", "op": "ge", "value": 1,
      "severity": "page", "absent": 0.0, "min_samples": 2},
+    # false-flag guard for honest deployments: on a byz=0 run every
+    # client flag is by construction a false positive (the failure mode
+    # IID-tuned detector constants hit on non-IID honest clients —
+    # docs/DESIGN.md "Tuning the defense").  The metric only counts
+    # flags folded from byz=0 streams, so a byz>0 run's genuine
+    # detections never fire this
+    {"name": "benign_false_flag_rate",
+     "metric": "aircomp_benign_flags_total",
+     "window": 8, "reduce": "delta", "op": "ge", "value": 1,
+     "severity": "warn", "absent": 0.0, "min_samples": 2},
 ]
 
 
@@ -367,6 +377,25 @@ def _scenarios() -> Dict[str, Dict[str, List[Dict[str, Any]]]]:
             "healthy": healthy_service,
             "breach": start + rounds(2) + [
                 _mk("edge_quarantine", edge=2, reason="partial_timeout"),
+            ] + rounds(2, start=2),
+        },
+        "benign_false_flag_rate": {
+            # healthy is deliberately NOT flag-free: a byz=2 run's genuine
+            # detection must leave the benign counter (and the whole pack)
+            # untouched — only byz=0 streams feed it
+            "healthy": [
+                _mk("run_start", title="t", backend="cpu", rounds=16,
+                    start_round=0, k=K, byz=2),
+            ] + rounds(2) + [
+                _mk("client_flag", round=2, client=7, score=9.0, rung=1,
+                    flagged=True),
+            ] + rounds(2, start=2),
+            "breach": [
+                _mk("run_start", title="t", backend="cpu", rounds=16,
+                    start_round=0, k=K, byz=0),
+            ] + rounds(2) + [
+                _mk("client_flag", round=2, client=3, score=4.0, rung=0,
+                    flagged=True),
             ] + rounds(2, start=2),
         },
     }

@@ -79,7 +79,14 @@ from typing import Any, Dict, Optional
 # bad_payload, nonfinite_partial, result_mismatch, strike_limit), and
 # ``edge_round`` (a round closed over the live set; ``degraded`` marks
 # a surviving-edge fold).
-SCHEMA_VERSION = 7
+# v8: added the defense auto-tuner kinds (tune/tuner.py): ``tune_candidate``
+# (one scored candidate — its knob params, the paired-lane fold's
+# precision/recall/benign false-flag rate, and the scalar objective),
+# ``tune_generation`` (one successive-halving generation closed:
+# population, per-generation round budget, promoted survivor count), and
+# ``tune_result`` (the tune's winner — exactly one per completed tune,
+# carrying the tuned constants the artifact file persists).
+SCHEMA_VERSION = 8
 
 # round-event field -> reference pickled-record key it mirrors
 # (round r's event carries metrics the record stores at index r+1 for the
@@ -162,6 +169,13 @@ _REQUIRED: Dict[str, tuple] = {
     "edge_reject": ("edge", "reason"),
     "edge_quarantine": ("edge", "reason"),
     "edge_round": ("round", "epoch", "edges", "degraded", "ingress_bytes"),
+    # defense auto-tuner (tune/tuner.py): one event per scored candidate
+    # (paired benign+attacked lane fold), one per closed generation, and
+    # exactly one tune_result carrying the winning constants
+    "tune_candidate": ("gen", "candidate", "objective", "precision",
+                       "recall", "benign_flag_rate"),
+    "tune_generation": ("gen", "population", "rounds", "survivors"),
+    "tune_result": ("generations", "objective", "params"),
 }
 
 

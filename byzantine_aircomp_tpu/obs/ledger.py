@@ -73,6 +73,12 @@ LEDGER_EXTRA_FIELDS = (
     # ≥10x acceptance gate reads (the R value is also baked into the
     # metric name, so same-R rows regression-test against each other)
     "rounds_per_dispatch",
+    # heterogeneity sweep rows (BENCH_HETERO): the Dirichlet level and
+    # quantity-skew spec behind the row — the alpha label is also baked
+    # into the metric name, so same-level rows regression-test against
+    # each other while the columns keep the row self-describing
+    "dirichlet_alpha",
+    "size_skew",
 )
 
 #: relative band half-width tolerated as noise (±10%)

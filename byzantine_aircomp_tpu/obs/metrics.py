@@ -271,6 +271,10 @@ class MetricsSink(EventSink):
 
     def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
         self.registry = registry if registry is not None else MetricsRegistry()
+        # byz count of the run being folded (from run_start): a client
+        # flag raised on a byz=0 run is by construction a false flag —
+        # the signal the benign_false_flag_rate SLO pages on
+        self._byz: Optional[int] = None
 
     # EventSink interface ------------------------------------------------
     def emit(self, event: Dict[str, Any]) -> None:
@@ -293,6 +297,10 @@ class MetricsSink(EventSink):
         if e.get("k") is not None:
             reg.set("aircomp_clients_k", e["k"],
                     help_text="configured round size K")
+        self._byz = e.get("byz")
+        if e.get("byz") is not None:
+            reg.set("aircomp_clients_byz", e["byz"],
+                    help_text="configured Byzantine count B")
         if e.get("rounds") is not None:
             reg.set("aircomp_rounds_scheduled", e["rounds"],
                     help_text="scheduled round horizon")
@@ -380,6 +388,16 @@ class MetricsSink(EventSink):
         if e.get("flagged"):
             self.registry.inc("aircomp_client_flags_total",
                               help_text="client_flag events with flagged=true")
+            if self._byz == 0:
+                # on a byz=0 run EVERY flag is a false positive — the
+                # dedicated counter gives the benign_false_flag_rate rule
+                # crisp semantics (a byz>0 run's genuine detections never
+                # touch it)
+                self.registry.inc(
+                    "aircomp_benign_flags_total",
+                    help_text="client flags raised on byz=0 runs "
+                              "(every one is a false positive)",
+                )
 
     def _on_retrace(self, e: Dict[str, Any]) -> None:
         reg = self.registry

@@ -1,0 +1,13 @@
+"""Defense auto-tuner: population-based search over the batchable
+detector/policy constants, riding the experiment-axis vmap engine
+(serve/batch.py) — see docs/DESIGN.md "Tuning the defense"."""
+
+from .space import (  # noqa: F401
+    DEFAULT_SPACE,
+    SearchSpace,
+    default_params,
+    sample_candidates,
+    validate_space,
+)
+from .objective import fold_pair, objective_score  # noqa: F401
+from .tuner import TuneJournal, Tuner  # noqa: F401

@@ -180,6 +180,9 @@ def test_run_titles_distinct_across_extension_knobs():
         dict(bucket_size=2),
         dict(client_momentum=0.9),
         dict(client_momentum=0.5),
+        dict(size_skew="zipf:1.0"),
+        dict(size_skew="zipf:2.0"),
+        dict(partition="dirichlet", size_skew="zipf:1.0"),
     ]
     titles = [
         run_title(FedConfig(honest_size=8, **v)) for v in variants
@@ -206,4 +209,12 @@ def test_ckpt_title_separates_configs_run_title_conflates():
     assert config_hash(a) == config_hash(FedConfig(honest_size=8, seed=2021))
     assert config_hash(a) == config_hash(
         FedConfig(honest_size=8, seed=2021, checkpoint_dir="/elsewhere/")
+    )
+    # size_skew joins the hash conditionally: the default "none" must not
+    # move hashes that predate the knob, while a real spec must fork
+    assert config_hash(a) == config_hash(
+        FedConfig(honest_size=8, seed=2021, size_skew="none")
+    )
+    assert config_hash(a) != config_hash(
+        FedConfig(honest_size=8, seed=2021, size_skew="zipf:1.0")
     )

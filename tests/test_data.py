@@ -230,3 +230,53 @@ def test_cifar10_hard_ceiling_and_shape():
                     "needs the synthetic fallback's shared rng stream")
     frac = float((ds.y_train != clean.y_train).mean())
     assert 0.04 < frac < 0.13, frac
+
+
+# ----------------------------------------------------- quantity skew (zipf)
+
+
+def test_zipf_shards_s0_is_contiguous_parity():
+    # the composition contract: zipf:0 weights are uniform, so the
+    # cumulative cut reduces to pieces[i] = floor(i*n/k) — bit-identical
+    # to contiguous_shards, which is what keeps --size-skew zipf:0 runs
+    # continuous with the pre-knob universe
+    for n, k in ((60000, 7), (1000, 16), (40, 2), (16, 16)):
+        a = data.contiguous_shards(n, k)
+        z = data.zipf_shards(n, k, 0.0)
+        np.testing.assert_array_equal(a.offsets, z.offsets)
+        np.testing.assert_array_equal(a.sizes, z.sizes)
+
+
+def test_zipf_shards_skew_shape_and_repair():
+    n, k, s = 1000, 16, 2.0
+    sh = data.zipf_shards(n, k, s)
+    assert sh.sizes.sum() == n
+    assert sh.num_clients == k
+    # zipf weight i^-s is decreasing, so sizes are non-increasing and
+    # client 0 holds the bulk
+    assert (np.diff(sh.sizes) <= 0).all()
+    assert sh.sizes[0] > sh.sizes[-1]
+    # every client keeps >= 1 sample even at the degenerate n == k edge
+    # (the forward-bump/backward-clamp repair)
+    tight = data.zipf_shards(16, 16, 3.0)
+    assert (tight.sizes >= 1).all()
+    assert tight.sizes.sum() == 16
+
+
+def test_zipf_shards_rejects_bad_inputs():
+    import pytest
+
+    with pytest.raises(ValueError):
+        data.zipf_shards(100, 10, -0.5)
+    with pytest.raises(ValueError):
+        data.zipf_shards(5, 10, 1.0)  # n < k cannot give everyone a sample
+
+
+def test_parse_size_skew_contract():
+    import pytest
+
+    assert data.parse_size_skew("none") is None
+    assert data.parse_size_skew("zipf:1.5") == 1.5
+    assert data.parse_size_skew("zipf:0") == 0.0
+    with pytest.raises(ValueError):
+        data.parse_size_skew("pareto:1.0")

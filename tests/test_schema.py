@@ -58,6 +58,10 @@ GOLDEN = {
     # containment, per-round root ingress — serve/edge.py,
     # serve/root.py, docs/SERVING.md)
     7: "59bc79ee93f254c9",
+    # v8 added the defense auto-tuner kinds tune_candidate /
+    # tune_generation / tune_result (ASHA generation trail + winning
+    # constants — tune/tuner.py, docs/DESIGN.md "Tuning the defense")
+    8: "15428fa8563bc0c9",
 }
 
 

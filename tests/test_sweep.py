@@ -140,6 +140,7 @@ def test_sweep_forwards_every_shared_knob():
         "client_momentum": 0.9,
         "partition": "dirichlet",
         "dirichlet_alpha": 0.7,
+        "size_skew": "zipf:1.5",
         "attack_param": 2.5,
         "krum_m": 2,
         "clip_tau": 1.5,

@@ -1,0 +1,369 @@
+"""Defense auto-tuner: space contract, objective oracle, halving
+determinism, journal resume-after-kill, and the one-lowering-per-
+generation retrace gate.
+
+The end-to-end tests drive the REAL ``tune/`` stack — a BatchRunner
+generation with paired (attacked, benign) lanes over the tiny synthetic
+mnist regime — so they double as integration coverage for the benign
+carry pin (``tuner.BENIGN_PIN``) and the audit byz-id plumbing.
+"""
+
+import copy
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from byzantine_aircomp_tpu.data import datasets as data_lib
+from byzantine_aircomp_tpu.fed.config import FedConfig
+from byzantine_aircomp_tpu.tune import objective as objective_lib
+from byzantine_aircomp_tpu.tune import space as space_lib
+from byzantine_aircomp_tpu.tune.tuner import Tuner
+
+# ------------------------------------------------------- space contract
+
+
+def test_default_space_validates():
+    assert space_lib.validate_space(space_lib.DEFAULT_SPACE) == sorted(
+        space_lib.DEFAULT_SPACE
+    )
+
+
+def test_space_rejects_structural_knobs():
+    # the economy of the tuner is one lowering per generation; a
+    # structural knob (ladder identity, aggregator) would force one
+    # lowering per CANDIDATE, so the space must refuse it outright
+    with pytest.raises(ValueError, match="batchable"):
+        space_lib.validate_space({"defense_ladder": (0, 1)})
+    with pytest.raises(ValueError, match="batchable"):
+        space_lib.validate_space({"agg": (0, 1)})
+
+
+def test_space_rejects_malformed_specs():
+    with pytest.raises(ValueError, match="empty"):
+        space_lib.validate_space({})
+    with pytest.raises(ValueError):
+        space_lib.validate_space({"defense_z": (2.0,)})
+    with pytest.raises(ValueError, match="lo < hi"):
+        space_lib.validate_space({"defense_z": (4.0, 2.0)})
+    with pytest.raises(ValueError, match="'log'"):
+        space_lib.validate_space({"defense_z": (2.0, 4.0, "exp")})
+    with pytest.raises(ValueError, match="integer"):
+        space_lib.validate_space({"defense_up": (1.5, 4)})
+    with pytest.raises(ValueError, match="log"):
+        space_lib.validate_space({"defense_up": (1, 4, "log")})
+    with pytest.raises(ValueError, match="lo > 0"):
+        space_lib.validate_space({"defense_leak": (0.0, 0.1, "log")})
+
+
+def test_candidate_zero_is_the_iid_defaults():
+    cands = space_lib.sample_candidates(space_lib.DEFAULT_SPACE, 5, seed=7)
+    cfg = FedConfig()
+    for knob, value in cands[0].items():
+        assert value == getattr(cfg, knob), knob
+
+
+def test_sampling_is_deterministic_and_in_bounds():
+    a = space_lib.sample_candidates(space_lib.DEFAULT_SPACE, 6, seed=3)
+    b = space_lib.sample_candidates(space_lib.DEFAULT_SPACE, 6, seed=3)
+    assert a == b  # exact float equality: resume depends on it
+    c = space_lib.sample_candidates(space_lib.DEFAULT_SPACE, 6, seed=4)
+    assert a[1:] != c[1:]  # different seed, different draws
+    for cand in a:
+        for knob, spec in space_lib.DEFAULT_SPACE.items():
+            lo, hi = spec[0], spec[1]
+            assert lo <= cand[knob] <= hi, (knob, cand[knob])
+            if knob in space_lib._INT_KNOBS:
+                assert isinstance(cand[knob], int), knob
+
+
+def test_apply_params_coerces_and_copies():
+    cfg = FedConfig()
+    out = space_lib.apply_params(
+        cfg, {"defense_z": 9.5, "defense_up": 4.0}
+    )
+    assert out is not cfg
+    assert out.defense_z == 9.5
+    assert out.defense_up == 4 and isinstance(out.defense_up, int)
+    assert cfg.defense_z == 4.0  # the base config is untouched
+
+
+# ------------------------------------------------- halving schedule math
+
+
+def test_halving_schedule_shape():
+    assert space_lib.halving_schedule(8, 3, 6, eta=2) == [
+        (8, 6), (4, 12), (2, 24)
+    ]
+    # never below one candidate, budget keeps doubling
+    assert space_lib.halving_schedule(2, 4, 5, eta=3) == [
+        (2, 5), (1, 15), (1, 45), (1, 135)
+    ]
+    with pytest.raises(ValueError):
+        space_lib.halving_schedule(8, 3, 6, eta=1)
+
+
+def test_survivors_protect_control_and_break_ties_by_index():
+    # candidate 0 survives even when it scored worst (the control lane
+    # the CI winner-vs-default gate needs at equal budget)
+    assert space_lib.survivors([-5.0, 1.0, 2.0, 3.0], keep=2) == [0, 3]
+    # exact ties promote the lower index — determinism under resume
+    assert space_lib.survivors([9.0, 1.0, 1.0, 1.0], keep=3) == [0, 1, 2]
+
+
+# ------------------------------------------------------ objective oracle
+
+
+def _canned_pair(k=4, rounds=10):
+    """A hand-auditable event pair: byz ids {2, 3}; the attacked lane
+    flags 3 at round 2 (hit), 1 at round 5 (false); the benign lane
+    raises one flag."""
+    attacked = [
+        {"kind": "run_start", "k": k, "byz": 2, "byz_ids": [2, 3],
+         "rounds": rounds, "attack": "signflip@2"},
+        {"kind": "client_flag", "round": 2, "client": 3, "flagged": True},
+        {"kind": "client_flag", "round": 5, "client": 1, "flagged": True},
+        {"kind": "client_flag", "round": 6, "client": 0, "flagged": False},
+    ]
+    benign = [
+        {"kind": "client_flag", "round": 4, "client": 1, "flagged": True},
+        {"kind": "client_flag", "round": 7, "client": 0, "flagged": False},
+    ]
+    return attacked, benign
+
+
+def test_fold_pair_matches_hand_computation():
+    k, rounds = 4, 10
+    attacked, benign = _canned_pair(k, rounds)
+    fold = objective_lib.fold_pair(attacked, benign, k=k, rounds=rounds)
+    # audit: 2 raised flags, 1 names a byz id -> precision 1/2; one of
+    # two byz ids ever flagged -> recall 1/2; first byz flag at round 2
+    assert fold["precision"] == 0.5
+    assert fold["recall"] == 0.5
+    assert fold["time_to_detect"] == 2
+    # benign lane: 1 flagged event over k*rounds = 40 client-rounds
+    assert fold["benign_flag_rate"] == pytest.approx(1 / 40)
+    expect = (
+        0.5 + 0.5
+        - objective_lib.DEFAULT_FF_PENALTY * (1 / 40)
+        - objective_lib.DEFAULT_TTD_WEIGHT * (2 / rounds)
+    )
+    assert fold["objective"] == pytest.approx(expect)
+
+
+def test_objective_score_edge_semantics():
+    # no flags at all: precision None scores as 1.0 (no phantom penalty),
+    # recall 0 and the full ttd charge do the punishing
+    s = objective_lib.objective_score(None, None, None, 0.0, rounds=8)
+    assert s == pytest.approx(1.0 - objective_lib.DEFAULT_TTD_WEIGHT)
+    # the ff penalty is the dominant trade term: one honest flag per
+    # round at k=16 charges 10/16 ≈ 0.62 — far more than the entire
+    # time-to-detect term can move (0.25), so slower-but-quiet beats
+    # instant-but-paging
+    ff = 1.0 / 16.0
+    s_quiet = objective_lib.objective_score(1.0, 1.0, 0, 0.0, rounds=8)
+    s_noisy = objective_lib.objective_score(1.0, 1.0, 0, ff, rounds=8)
+    assert s_quiet - s_noisy == pytest.approx(
+        objective_lib.DEFAULT_FF_PENALTY * ff
+    )
+    assert (objective_lib.DEFAULT_FF_PENALTY * ff
+            > objective_lib.DEFAULT_TTD_WEIGHT)
+
+
+def test_benign_flag_rate_counts_only_flagged():
+    _, benign = _canned_pair()
+    assert objective_lib.benign_flag_rate(benign, 4, 10) == 1 / 40
+    assert objective_lib.benign_flag_rate([], 4, 10) == 0.0
+    assert objective_lib.benign_flag_rate(benign, 0, 0) == 0.0
+
+
+# ----------------------------------------------- end-to-end (tiny stack)
+
+
+def _tiny_cfg(**over):
+    kw = dict(
+        honest_size=6,
+        byz_size=2,
+        attack="signflip@1",
+        agg="mean",
+        defense="adaptive",
+        forensics="top",
+        forensics_top=8,
+        dataset="mnist_hard",
+        batch_size=4,
+        display_interval=1,
+        eval_train=False,
+        rounds=1,
+        seed=2021,
+        # the tiny horizon is 2-4 rounds of 1 iteration each; the default
+        # warmup (5) would never arm the detector inside it
+        defense_warmup=1,
+    )
+    kw.update(over)
+    cfg = FedConfig(**kw)
+    cfg.validate()
+    return cfg
+
+
+def _tiny_dataset():
+    return data_lib.load("mnist_hard", synthetic_train=256, synthetic_val=64)
+
+
+#: a narrowed space keeps the tiny tune fast while still exercising the
+#: int/log/linear sampling paths
+_TINY_SPACE = {
+    "defense_z": (2.0, 16.0, "log"),
+    "defense_up": (2, 4),
+    "defense_floor": (0.5, 4.0),
+}
+
+
+def _tiny_tuner(journal_path=None, **over):
+    kw = dict(
+        population=3,
+        generations=2,
+        base_rounds=2,
+        eta=2,
+        seed=0,
+        dataset=_tiny_dataset(),
+        journal_path=journal_path,
+    )
+    kw.update(over)
+    return Tuner(_tiny_cfg(), _TINY_SPACE, **kw)
+
+
+def test_tuner_validates_base_config():
+    with pytest.raises(ValueError, match="onset"):
+        Tuner(_tiny_cfg(attack="signflip"), _TINY_SPACE,
+              dataset=_tiny_dataset())
+    with pytest.raises(ValueError, match="defense"):
+        # defense_warmup back at its default: config.validate() rejects
+        # touched defense knobs under --defense off before the tuner can
+        Tuner(_tiny_cfg(defense="off", defense_warmup=5), _TINY_SPACE,
+              dataset=_tiny_dataset())
+    with pytest.raises(ValueError, match="forensics"):
+        Tuner(_tiny_cfg(forensics="off"), _TINY_SPACE,
+              dataset=_tiny_dataset())
+
+
+@pytest.fixture(scope="module")
+def tiny_result():
+    """One uninterrupted tiny tune, shared by the structural assertions
+    (module-scoped: the tune itself is the expensive part)."""
+    tuner = _tiny_tuner()
+    result = tuner.run()
+    return tuner, result
+
+
+def test_tiny_tune_one_lowering_per_generation(tiny_result):
+    tuner, result = tiny_result
+    # the retrace gate: gen 0 (3 pairs) and gen 1 (2 pairs) differ in
+    # lane COUNT, so two lowerings are expected — but candidates within
+    # a generation ride one program (knobs are traced data, lanes are
+    # the vmap axis), so lowerings == generations, never == candidates
+    assert tuner.lowerings == tuner.generations == 2
+    assert result["lowerings"] == 2
+
+
+def test_tiny_tune_structure_and_control_lane(tiny_result):
+    tuner, result = tiny_result
+    plan = space_lib.halving_schedule(3, 2, 2, eta=2)
+    assert [t["rounds"] for t in tuner.trail] == [r for _, r in plan]
+    # candidate 0 (IID defaults) is scored in EVERY generation
+    for t in tuner.trail:
+        assert 0 in t["scored"]
+        assert 0 in t["survivors"]
+    # the artifact carries both sides of the comparison at equal budget
+    assert result["default"]["params"] == tuner.candidates[0]
+    assert "objective" in result["default"]
+    assert result["tuned"]["objective"] >= result["default"]["objective"]
+    for fold in tuner.trail[-1]["scored"].values():
+        assert 0.0 <= fold["benign_flag_rate"] <= 1.0
+
+
+def test_tiny_tune_benign_lanes_stay_benign(tiny_result):
+    tuner, result = tiny_result
+    # the attacked lanes must actually see the attack: recall > 0 for at
+    # least the winner (signflip at this scale is unmissable)
+    assert result["tuned"]["recall"] is not None
+    assert result["tuned"]["recall"] > 0
+
+
+# --------------------------------------------- journal resume after kill
+
+
+@pytest.fixture(scope="module")
+def journaled_pair(tmp_path_factory):
+    """An uninterrupted journaled tune plus its journal records — the
+    ground truth the kill/resume tests replay against."""
+    path = str(tmp_path_factory.mktemp("tune") / "tune.journal.jsonl")
+    tuner = _tiny_tuner(journal_path=path)
+    result = tuner.run()
+    with open(path) as f:
+        lines = f.read().splitlines()
+    return path, result, lines
+
+
+def test_journal_records_every_boundary(journaled_pair):
+    path, result, lines = journaled_pair
+    ops = [json.loads(ln)["op"] for ln in lines]
+    assert ops[0] == "tune_start"
+    assert ops.count("gen_start") == 2
+    assert ops.count("gen_done") == 2
+    assert ops[-1] == "tune_done"
+
+
+def test_resume_mid_generation_is_bit_identical(journaled_pair, tmp_path):
+    path, full_result, lines = journaled_pair
+    # simulate a SIGKILL DURING generation 1: the journal holds
+    # tune_start + gen 0 (start+done) + gen 1's start, but no gen 1 done
+    cut = [
+        ln for ln in lines
+        if json.loads(ln)["op"] != "tune_done"
+        and not (json.loads(ln)["op"] == "gen_done"
+                 and json.loads(ln)["gen"] == 1)
+    ]
+    killed = str(tmp_path / "killed.journal.jsonl")
+    with open(killed, "w") as f:
+        f.write("\n".join(cut) + "\n")
+
+    tuner = _tiny_tuner(journal_path=killed)
+    result = tuner.run()
+    # gen 0 restored from the journal, gen 1 re-run live
+    assert [t["resumed"] for t in tuner.trail] == [True, False]
+    assert tuner.lowerings == 1  # only the re-run generation lowered
+    # bit-identical to the uninterrupted tune: same winner, same floats
+    assert result["tuned"] == full_result["tuned"]
+    assert result["default"] == full_result["default"]
+    for a, b in zip(result["trail"], full_result["trail"]):
+        assert a["scored"] == b["scored"]
+        assert a["survivors"] == b["survivors"]
+
+
+def test_resume_tolerates_torn_tail(journaled_pair, tmp_path):
+    path, full_result, lines = journaled_pair
+    # a kill mid-append truncates at worst its own line: half a gen_done
+    # must replay as "generation not finished", not crash
+    torn = str(tmp_path / "torn.journal.jsonl")
+    keep = [ln for ln in lines if json.loads(ln)["op"] != "tune_done"]
+    with open(torn, "w") as f:
+        f.write("\n".join(keep[:-1]) + "\n")
+        f.write(keep[-1][: len(keep[-1]) // 2])  # torn final gen_done
+
+    tuner = _tiny_tuner(journal_path=torn)
+    result = tuner.run()
+    assert [t["resumed"] for t in tuner.trail] == [True, False]
+    assert result["tuned"] == full_result["tuned"]
+
+
+def test_resume_refuses_foreign_journal(journaled_pair, tmp_path):
+    path, _result, lines = journaled_pair
+    foreign = str(tmp_path / "foreign.journal.jsonl")
+    with open(foreign, "w") as f:
+        f.write(lines[0] + "\n")
+    # same journal, different tune configuration -> hard refusal (a
+    # silent mix would attribute one run's scores to another's space)
+    with pytest.raises(ValueError, match="different tune configuration"):
+        _tiny_tuner(journal_path=foreign, seed=1).run()
