@@ -276,6 +276,83 @@ def scenario_kill9(workdir: str) -> None:
     print("kill9: OK")
 
 
+def scenario_refill_kill(workdir: str) -> None:
+    """SIGKILL the server after an elastic lane refill: a short tenant
+    drains its lane mid-group, a late tenant refills the slot (the
+    ``refill`` journal record is the WAL), then kill -9 lands.  The
+    restarted server must reseat the SAME tenant into the SAME lane and
+    every record must be bit-identical to a never-killed baseline."""
+    root = os.path.join(workdir, "root")
+    rounds = BASE_CFG["rounds"]
+    srv = Server(root, os.path.join(workdir, "serve.log"))
+    a = srv.submit(seed=1, rounds=3)  # drains early -> frees its lane
+    b = srv.submit(seed=2)  # keeps the group alive for the refill
+    # the group must have formed before the late tenant arrives, or it
+    # would just widen the initial batch instead of refilling
+    srv.wait_round(a, 1)
+    c = srv.submit(seed=3)
+    # wait until the refill decision is DURABLE (the journal is the
+    # write-ahead log: the record lands before the device splice), then
+    # kill.  On a fast box C may even finish first — recovery of a
+    # completed refill is an invariant too, so both timings are valid.
+    journal = os.path.join(root, "journal.jsonl")
+    refill_lane = None
+    end = time.time() + _RUN_DEADLINE
+    while time.time() < end:
+        try:
+            with open(journal) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("op") == "refill" and rec.get("run_id") == c:
+                        refill_lane = rec["lane"]
+        except OSError:
+            pass
+        if refill_lane is not None or all(
+            r["status"] in ("completed", "failed", "cancelled")
+            for r in srv.runs()
+        ):
+            break
+        time.sleep(0.05)
+    assert refill_lane is not None, (
+        f"late tenant {c} never refilled a lane (journal has no refill "
+        f"record); the group drained without reseating it"
+    )
+    srv.kill9()
+    print(
+        f"killed -9 after {c} refilled lane {refill_lane}; restarting "
+        f"on the same obs root"
+    )
+    srv2 = Server(root, os.path.join(workdir, "serve2.log"))
+    try:
+        runs = srv2.wait_all_terminal()
+        by_id = {r["run_id"]: r for r in runs}
+        for rid in (a, b, c):
+            assert by_id[rid]["status"] == "completed", by_id[rid]
+            assert by_id[rid].get("lowerings") == 1, by_id[rid]
+        # the replay invariant: same tenant, same seat
+        assert by_id[c]["lane"] == refill_lane, (
+            f"{c} reseated into lane {by_id[c]['lane']}, journal "
+            f"said {refill_lane}"
+        )
+    finally:
+        srv2.close()
+    # baseline: same three tenants on a fresh root, never killed
+    broot = os.path.join(workdir, "baseline")
+    bsrv = Server(broot, os.path.join(workdir, "baseline.log"))
+    try:
+        bsrv.submit(seed=1, rounds=3)
+        bsrv.submit(seed=2, rounds=rounds)
+        bsrv.submit(seed=3, rounds=rounds)
+        base = bsrv.wait_all_terminal()
+    finally:
+        bsrv.close()
+    _assert_records_match(runs, base, (1, 2, 3))
+    print("refill_kill: OK")
+
+
 def scenario_torn_tail(workdir: str) -> None:
     root = os.path.join(workdir, "root")
     srv = Server(root, os.path.join(workdir, "serve.log"))
@@ -900,6 +977,7 @@ SCENARIOS = {
     "torn_tail": scenario_torn_tail,
     "kill_midckpt": scenario_kill_midckpt,
     "kill_midckpt_rd4": scenario_kill_midckpt_rd4,
+    "refill_kill": scenario_refill_kill,
     "poisoned": scenario_poisoned,
     "slow_tenant": scenario_slow_tenant,
     "smoke": scenario_smoke,

@@ -165,6 +165,14 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
      "metric": "aircomp_benign_flags_total",
      "window": 8, "reduce": "delta", "op": "ge", "value": 1,
      "severity": "warn", "absent": 0.0, "min_samples": 2},
+    # elastic lane groups: occupancy (live lanes / width, sampled per
+    # round by the scheduler's lane_group events) sagging below 90% for
+    # 4 straight samples means the refill path is not keeping lanes fed
+    # despite a queue (or the queue itself ran dry under churn).  No
+    # ``absent`` stand-in: runs without a lane group stay silent.
+    {"name": "lane_occupancy_floor", "metric": "aircomp_lane_occupancy",
+     "window": 4, "reduce": "max", "op": "lt", "value": 0.9,
+     "severity": "warn", "min_samples": 4},
 ]
 
 
@@ -397,6 +405,29 @@ def _scenarios() -> Dict[str, Dict[str, List[Dict[str, Any]]]]:
                 _mk("client_flag", round=2, client=3, score=4.0, rung=0,
                     flagged=True),
             ] + rounds(2, start=2),
+        },
+        "lane_occupancy_floor": {
+            # a single-round sag (one lane draining before its refill
+            # lands) must NOT fire: the window max sees the recovery
+            "healthy": start + [
+                e for r in range(6)
+                for e in (
+                    _mk("lane_group", round=r, lanes=8,
+                        live=7 if r == 3 else 8,
+                        occupancy=0.875 if r == 3 else 1.0,
+                        queue_depth=0),
+                    rounds(1, start=r)[0],
+                )
+            ],
+            # sustained half-empty group: refill starved for 5 rounds
+            "breach": start + [
+                e for r in range(5)
+                for e in (
+                    _mk("lane_group", round=r, lanes=8, live=4,
+                        occupancy=0.5, queue_depth=0),
+                    rounds(1, start=r)[0],
+                )
+            ],
         },
     }
 

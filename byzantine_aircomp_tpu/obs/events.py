@@ -86,7 +86,14 @@ from typing import Any, Dict, Optional
 # population, per-generation round budget, promoted survivor count), and
 # ``tune_result`` (the tune's winner — exactly one per completed tune,
 # carrying the tuned constants the artifact file persists).
-SCHEMA_VERSION = 8
+# v9: added the elastic-scheduling kinds (serve/runs.py + serve/elastic.py):
+# ``lane_group`` (one per group round boundary, scheduler-scoped — group
+# width, live-lane count, the occupancy ratio the >90% acceptance gauge
+# reads, and the admission-queue depth behind it) and ``lane_refill`` (a
+# drained lane's slot reseated from the admission queue mid-group: which
+# lane, the incoming tenant's own resume round, and the group round the
+# splice landed at — the journal's ``refill`` op is the durable twin).
+SCHEMA_VERSION = 9
 
 # round-event field -> reference pickled-record key it mirrors
 # (round r's event carries metrics the record stores at index r+1 for the
@@ -161,6 +168,11 @@ _REQUIRED: Dict[str, tuple] = {
     "run_failed": ("run_id", "round", "reason"),
     "run_requeued": ("run_id", "round", "retries", "reason"),
     "journal_replay": ("run_id", "status", "round"),
+    # elastic lane scheduling (serve/runs.py group loop): the per-round
+    # group occupancy sample the >90% acceptance gauge reads, and the
+    # mid-group reseat of a drained lane from the admission queue
+    "lane_group": ("round", "lanes", "live", "occupancy", "queue_depth"),
+    "lane_refill": ("run_id", "lane", "round", "group_round"),
     # 2-tier aggregation (serve/root.py): the root's zero-trust audit
     # trail — accepted partials (with wire bytes for the ingress ledger),
     # rejections (reason: bad_mac/replay/...), edge containment, and the

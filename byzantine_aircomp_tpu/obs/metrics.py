@@ -479,6 +479,31 @@ class MetricsSink(EventSink):
             status=str(e.get("status", "?")),
         )
 
+    # elastic lane scheduling (serve/runs.py group loop): per-round
+    # occupancy samples — the >90% acceptance bar, and the series the
+    # lane_occupancy_floor alert windows over — plus the refill counter
+
+    def _on_lane_group(self, e: Dict[str, Any]) -> None:
+        reg = self.registry
+        if _finite(e.get("occupancy")):
+            reg.set("aircomp_lane_occupancy", float(e["occupancy"]),
+                    help_text="live lanes / group width, sampled per round")
+        if _finite(e.get("live")):
+            reg.set("aircomp_lanes_live", float(e["live"]),
+                    help_text="lanes with a seated live tenant")
+        if _finite(e.get("lanes")):
+            reg.set("aircomp_lanes_total", float(e["lanes"]),
+                    help_text="lane-group width (vmapped batch size)")
+        if _finite(e.get("queue_depth")):
+            reg.set("aircomp_admission_queue_depth", float(e["queue_depth"]),
+                    help_text="runs queued for admission to a lane group")
+
+    def _on_lane_refill(self, e: Dict[str, Any]) -> None:
+        self.registry.inc(
+            "aircomp_lane_refills_total",
+            help_text="drained lane slots reseated from the admission queue",
+        )
+
     # 2-tier aggregation (serve/root.py events): the root's zero-trust
     # counters — ingress volume, rejections by reason, containment, and
     # degraded-round visibility (obs/alerts.py pages on quarantine rate)

@@ -8,6 +8,12 @@ Two rungs (ROADMAP item #4):
   (:class:`~.batch.BatchableKnobs`) instead of hashed statics.  One
   lowering serves every cell; the seed-only batch is bit-identical to N
   independent solo runs.
+* :mod:`.elastic` — elastic lane scheduling on top of the batch runner:
+  streamed/mesh tenants batch too (their trace-gating knobs PINNED
+  instead of refused), the lane axis can shard over the device mesh
+  (``backend="shard_vmap"``), and drained lanes refill from the
+  admission queue between rounds with journaled, SIGKILL-replayable
+  seat decisions.
 * :mod:`.runs` + :mod:`.server` — the resident control plane: a stdlib
   HTTP surface (extending ``obs/exporter.py``) to submit / inspect /
   cancel runs and hot-swap batchable knobs between rounds, with per-run
@@ -19,11 +25,19 @@ See docs/SERVING.md for the API and the batchable-knob contract.
 
 from .batch import (  # noqa: F401
     BATCHABLE_KNOBS,
+    PINNED_STREAM_KNOBS,
     BatchRunner,
     applicable_knobs,
     gather_knobs,
     static_signature,
     validate_batch,
+)
+from .elastic import (  # noqa: F401
+    ElasticBatchRunner,
+    pinned_knobs,
+    runner_for,
+    seat_order,
+    validate_stream_batch,
 )
 from .runs import RunManager  # noqa: F401
 from .server import ExperimentServer  # noqa: F401

@@ -31,6 +31,19 @@ path selection (service/cohort/participation/bucketing/momentum/fedprox),
 server-optimizer wiring — must be equal; output-only observability knobs
 may differ freely; the knobs in :data:`BATCHABLE_KNOBS` become per-lane
 data.
+
+Elastic lanes: the round index is itself per-lane data (an ``[N]``
+``int32`` vmapped alongside the carry), so lanes may sit at DIFFERENT
+rounds of their own trajectories inside one dispatch.  That is what
+makes lane *refill* possible without a retrace: when a tenant drains or
+is cancelled, :meth:`BatchRunner.release_lane` frees its slot (and its
+quarantine/strike state — a refilled tenant must not inherit the prior
+occupant's forensic counters) and :meth:`BatchRunner.install_lane`
+splices a new tenant's carry row, base key, knob columns, and own round
+counter into the SAME compiled program.  Each lane retires at its own
+``cfg.rounds`` horizon; the driver loop runs until every lane is
+inactive.  ``serve/elastic.py`` builds the scheduling policy (and the
+``shard_map``-over-vmap mesh-tenant backend) on top of these hooks.
 """
 
 from __future__ import annotations
@@ -76,6 +89,14 @@ _INT_KNOBS = frozenset(
     {"defense_warmup", "defense_up", "defense_down", "defense_min_flagged"}
 )
 
+#: batchable knobs the STREAMED iteration path Python-gates on (reads
+#: concretely at trace time to pick cohort-scan structure): a streamed
+#: batch must PIN these — equal across the batch, traced as closure
+#: constants, excluded from hot-swap.  ``serve/elastic.py`` enforces it;
+#: :func:`static_signature` folds them into a streamed config's digest
+#: so tenants that disagree can never be grouped together.
+PINNED_STREAM_KNOBS = ("straggler_prob",)
+
 #: every knob that can ride the experiment axis as traced data.  ``seed``
 #: is batchable *structurally*: each lane carries its own base key and
 #: initial params, no tracer needed.
@@ -120,28 +141,27 @@ def applicable_knobs(cfg: FedConfig) -> List[str]:
     return knobs
 
 
-def validate_batch(cfgs: Sequence[FedConfig]) -> List[str]:
-    """The batchable-knob contract.  Raises ``ValueError`` naming the
-    first violation; returns the applicable traced-knob names on success.
+#: structural-looking fields that are actually host-driver horizons: the
+#: per-lane driver loop reads them in Python only, so lanes may differ
+#: (a lane retires at its own ``rounds``) — required for elastic refill,
+#: where a freed slot is reseated by a tenant mid-way through the
+#: group's life
+_PER_LANE_HORIZON = ("rounds",)
 
-    Must match across the batch: every FedConfig field that is neither
-    batchable (:data:`BATCHABLE_KNOBS`) nor output-only — shapes,
-    aggregator/ladder/attack identity, path selection, ``rounds``.
-    Presence classes must match where a knob's *existence* gates traced
-    structure: ``attack_param`` / ``noise_var`` are all-None or all-set.
-    Additional structural constraints of the v1 runner: no streamed
-    cohorts (``cohort_size == 0`` — the cohort scan Python-gates on knob
-    values), ``service == "on"`` requires ``rollback == "off"`` (warm
-    rollback restores host state per run and cannot ride a shared batch
-    carry), and a ``dirichlet`` partition requires matching seeds (the
-    data permutation is seed-derived, and lanes share one data layout).
-    """
+
+def _validate_structure(cfgs: Sequence[FedConfig]) -> List[str]:
+    """The shared structural contract (everything in
+    :func:`validate_batch` except the streamed-cohort carve-out).
+    Raises ``ValueError`` naming the first violation; returns the
+    applicable traced-knob names on success."""
     if not cfgs:
         raise ValueError("validate_batch: empty batch")
     for cfg in cfgs:
         cfg.validate()
     t = cfgs[0]
-    skip = set(BATCHABLE_KNOBS) | set(_OUTPUT_ONLY)
+    skip = (
+        set(BATCHABLE_KNOBS) | set(_OUTPUT_ONLY) | set(_PER_LANE_HORIZON)
+    )
     for f in dataclasses.fields(FedConfig):
         if f.name in skip:
             continue
@@ -159,12 +179,6 @@ def validate_batch(cfgs: Sequence[FedConfig]) -> List[str]:
                 f"batch contract: {knob} presence must match across the "
                 f"batch (None gates a traced branch); mix of set/None"
             )
-    if t.cohort_size != 0:
-        raise ValueError(
-            "batch contract: cohort streaming (cohort_size > 0) is not "
-            "batchable — the cohort scan selects structure from knob "
-            "values; run streamed configs solo"
-        )
     if t.service == "on" and t.rollback != "off":
         raise ValueError(
             "batch contract: service batches require rollback='off' "
@@ -183,11 +197,42 @@ def validate_batch(cfgs: Sequence[FedConfig]) -> List[str]:
     return applicable_knobs(t)
 
 
+def validate_batch(cfgs: Sequence[FedConfig]) -> List[str]:
+    """The batchable-knob contract of the base (resident-path) runner.
+    Raises ``ValueError`` naming the first violation; returns the
+    applicable traced-knob names on success.
+
+    Must match across the batch: every FedConfig field that is neither
+    batchable (:data:`BATCHABLE_KNOBS`), output-only, nor a host-driver
+    horizon (``rounds`` — each lane retires at its own) — shapes,
+    aggregator/ladder/attack identity, path selection.  Presence
+    classes must match where a knob's *existence* gates traced
+    structure: ``attack_param`` / ``noise_var`` are all-None or all-set.
+    Additional structural constraints: no streamed cohorts
+    (``cohort_size == 0`` — the cohort scan Python-gates on knob values;
+    ``serve/elastic.py`` lifts this by pinning the gating knobs),
+    ``service == "on"`` requires ``rollback == "off"`` (warm rollback
+    restores host state per run and cannot ride a shared batch carry),
+    and a ``dirichlet`` partition requires matching seeds (the data
+    permutation is seed-derived, and lanes share one data layout).
+    """
+    knobs = _validate_structure(cfgs)
+    if cfgs[0].cohort_size != 0:
+        raise ValueError(
+            "batch contract: cohort streaming (cohort_size > 0) is not "
+            "batchable — the cohort scan selects structure from knob "
+            "values; run streamed configs solo"
+        )
+    return knobs
+
+
 def static_signature(cfg: FedConfig) -> str:
     """Stable digest of everything :func:`validate_batch` requires to
     match — two configs with equal signatures can share one
     :class:`BatchRunner` (the RunManager's grouping key)."""
-    skip = set(BATCHABLE_KNOBS) | set(_OUTPUT_ONLY)
+    skip = (
+        set(BATCHABLE_KNOBS) | set(_OUTPUT_ONLY) | set(_PER_LANE_HORIZON)
+    )
     parts = []
     for f in sorted(dataclasses.fields(FedConfig), key=lambda f: f.name):
         if f.name in skip:
@@ -197,6 +242,11 @@ def static_signature(cfg: FedConfig) -> str:
     parts.append(f"noise_var_set={cfg.noise_var is not None}")
     if cfg.partition == "dirichlet":
         parts.append(f"seed={cfg.seed}")
+    if cfg.cohort_size > 0:
+        # streamed tenants additionally pin the Python-gated knobs: two
+        # configs that disagree can never share a lowering
+        for knob in PINNED_STREAM_KNOBS:
+            parts.append(f"{knob}={getattr(cfg, knob)!r}")
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:12]
 
 
@@ -275,13 +325,15 @@ class BatchRunner:
         from ..data import datasets as data_lib
         from ..fed.train import FedTrainer
 
-        self.knob_names = validate_batch(cfgs)
-        if backend not in ("vmap", "map"):
-            raise ValueError(f"backend must be 'vmap' or 'map', got {backend!r}")
+        self.knob_names = self._validate(cfgs)
+        self.backend = backend
         self.cfgs = list(cfgs)
         self.n = len(self.cfgs)
-        dataset = dataset or data_lib.load(self.cfgs[0].dataset)
-        self.trainers = [FedTrainer(c, dataset=dataset) for c in self.cfgs]
+        self.dataset = dataset or data_lib.load(self.cfgs[0].dataset)
+        build = self._builder(backend)  # raises on an unknown backend
+        self.trainers = [
+            FedTrainer(c, dataset=self.dataset) for c in self.cfgs
+        ]
         if restore_fn is not None:
             # checkpoint resume hook: install restored state into each
             # lane's trainer BEFORE the carries are stacked (the server's
@@ -289,7 +341,7 @@ class BatchRunner:
             for lane, t in enumerate(self.trainers):
                 restore_fn(lane, t)
         self.template = self.trainers[0]
-        self.knobs = gather_knobs(self.cfgs)
+        self.knobs = self._gather_knobs()
         self.carry = jax.tree.map(
             lambda *xs: jnp.stack(xs),
             *[self._carry_of(t) for t in self.trainers],
@@ -297,20 +349,51 @@ class BatchRunner:
         self.base_keys = jnp.stack([t._base_key for t in self.trainers])
         self.retrace = retrace or obs_lib.RetraceDetector()
         self.active = [True] * self.n
+        #: per-lane round cursor: lane i's NEXT round of its own
+        #: trajectory (elastic lanes may sit at different rounds)
+        self.lane_rounds = [0] * self.n
+        #: lanes reseated via install_lane over this runner's lifetime
+        self.refills = 0
         #: lane -> quarantine reason; a poisoned lane (non-finite params/
         #: variance/loss, exception in its eval) is evicted from recording
         #: while the surviving lanes continue in the same lowering
         self.failed: Dict[int, str] = {}
-        build = self._build_vmap if backend == "vmap" else self._build_map
         self._batched_fn = jax.jit(
             self.retrace.wrap("batch_round_fn", build()),
-            donate_argnums=(0,),
+            donate_argnums=self._donate_argnums(),
         )
         # last per-lane metric rows ([N, ...] device arrays, () when off)
         self.last_fault_metrics = ()
         self.last_defense_metrics = ()
         self.last_service_metrics = ()
         self.last_forensic_metrics = ()
+
+    def _validate(self, cfgs: Sequence[FedConfig]) -> List[str]:
+        """The admission contract; subclasses widen it (elastic runners
+        admit streamed configs with pinned gating knobs)."""
+        return validate_batch(cfgs)
+
+    def _builder(self, backend: str) -> Callable[[], Callable]:
+        if backend == "vmap":
+            return self._build_vmap
+        if backend == "map":
+            return self._build_map
+        raise ValueError(f"backend must be 'vmap' or 'map', got {backend!r}")
+
+    def _donate_argnums(self) -> tuple:
+        """Donate the carry into the batched fn (subclasses narrow this
+        where donation is unsound, mirroring parallel/popmesh.py's CPU
+        shard_map caveat)."""
+        return (0,)
+
+    def _gather_knobs(self) -> Dict[str, jnp.ndarray]:
+        out = {}
+        for k in self.knob_names:
+            dtype = jnp.int32 if k in _INT_KNOBS else jnp.float32
+            out[k] = jnp.asarray(
+                [getattr(c, k) for c in self.cfgs], dtype=dtype
+            )
+        return out
 
     @staticmethod
     def _carry_of(t):
@@ -330,7 +413,7 @@ class BatchRunner:
     def _build_vmap(self):
         def batched(carry, base_keys, knobs, round_idx):
             return jax.vmap(
-                self._one, in_axes=(0, 0, 0, None)
+                self._one, in_axes=(0, 0, 0, 0)
             )(carry, base_keys, knobs, round_idx)
 
         return batched
@@ -338,21 +421,30 @@ class BatchRunner:
     def _build_map(self):
         def batched(carry, base_keys, knobs, round_idx):
             def elem(args):
-                c, k, kn = args
-                return self._one(c, k, kn, round_idx)
+                c, k, kn, r = args
+                return self._one(c, k, kn, r)
 
-            return jax.lax.map(elem, (carry, base_keys, knobs))
+            return jax.lax.map(elem, (carry, base_keys, knobs, round_idx))
 
         return batched
 
     # -------------------------------------------------------- execution
 
-    def run_round(self, round_idx: int):
+    def run_round(self, round_idx):
         """One batched round; returns the per-lane honest-dispersion
         metric ``[N]`` as a device array (no host sync — the solo
-        ``run_round`` discipline)."""
+        ``run_round`` discipline).  ``round_idx`` is a scalar (every lane
+        at the same round — the uniform-batch fast path and the legacy
+        caller surface) or a length-N sequence of per-lane rounds
+        (elastic groups whose lanes sit at different points of their own
+        trajectories).  Either way the jitted fn sees ONE ``[N]`` int32
+        aval, so mixing scalars and lists can never retrace."""
+        if np.ndim(round_idx) == 0:
+            rounds = jnp.full((self.n,), int(round_idx), jnp.int32)
+        else:
+            rounds = jnp.asarray(round_idx, jnp.int32)
         out = self._batched_fn(
-            self.carry, self.base_keys, self.knobs, jnp.int32(round_idx)
+            self.carry, self.base_keys, self.knobs, rounds
         )
         self.carry = tuple(out[:7])
         (
@@ -452,7 +544,67 @@ class BatchRunner:
         rides the batch (masking it out would change nothing — the
         program is shape-static) but it stops producing records, events,
         or evals; when every lane is cancelled the driver loop exits."""
+        self.release_lane(lane)
+
+    # -------------------------------------------------- elastic lanes
+
+    def release_lane(self, lane: int) -> None:
+        """Free a lane slot for refill: deactivate it AND clear its
+        quarantine/strike state, so a tenant reseated into this lane
+        never inherits the prior occupant's forensic counters (the
+        cancel-then-refill contamination bug)."""
         self.active[lane] = False
+        self.failed.pop(lane, None)
+
+    def install_lane(
+        self,
+        lane: int,
+        cfg: FedConfig,
+        own_round: int = 0,
+        restored=None,
+        paths: Optional[Dict[str, list]] = None,
+    ) -> None:
+        """Reseat a freed lane with a new tenant, reusing the existing
+        lowering: build its trainer (optionally restoring a checkpoint —
+        the journal's requeue path, so a refilled resume is bit-identical
+        to the uninterrupted run), splice its carry row / base key / knob
+        columns into the stacked state, and start its own round cursor at
+        ``own_round``.  Shapes and dtypes are pinned by the signature
+        contract, so the splice is pure data movement — the retrace gate
+        stays at one lowering."""
+        from ..fed import harness
+        from ..fed.train import FedTrainer
+
+        self._validate([self.cfgs[0], cfg])
+        t = FedTrainer(cfg, dataset=self.dataset)
+        if restored is not None:
+            harness.restore_trainer(t, cfg, restored, log_fn=lambda s: None)
+        self.cfgs[lane] = cfg
+        self.trainers[lane] = t
+        self.carry = jax.tree.map(
+            lambda leaf, row: leaf.at[lane].set(row),
+            self.carry, self._carry_of(t),
+        )
+        self.base_keys = self.base_keys.at[lane].set(t._base_key)
+        for k, arr in self.knobs.items():
+            self.knobs[k] = arr.at[lane].set(
+                jnp.asarray(getattr(cfg, k), dtype=arr.dtype)
+            )
+        self.active[lane] = True
+        self.failed.pop(lane, None)
+        self.lane_rounds[lane] = int(own_round)
+        self.refills += 1
+        if getattr(self, "_prev_rung", None) is not None:
+            self._prev_rung[lane] = (
+                int(t.defense_state[1][0]) if t.defense is not None
+                else None
+            )
+        if getattr(self, "paths_list", None) is not None:
+            # AFTER the carry splice: a fresh lane's index-0 eval reads
+            # the newly installed params
+            self.paths_list[lane] = (
+                dict(paths) if paths is not None else self._init_paths(lane)
+            )
 
     # -------------------------------------------------------- driver
 
@@ -496,21 +648,28 @@ class BatchRunner:
         after_round: Optional[Callable[[int], None]] = None,
         resume_paths: Optional[Sequence[Optional[Dict[str, list]]]] = None,
         on_quarantine: Optional[Callable[[int, int, str], None]] = None,
+        start_rounds: Optional[Sequence[int]] = None,
+        on_lane_done: Optional[Callable[[int], None]] = None,
     ) -> List[Dict[str, list]]:
-        """Drive every lane to ``cfg.rounds``; returns per-lane paths
-        dicts mirroring ``FedTrainer.train`` (same keys, same float
+        """Drive every lane to its own ``cfg.rounds``; returns per-lane
+        paths dicts mirroring ``FedTrainer.train`` (same keys, same float
         conversions — the bit-identity surface).  ``obs_list`` supplies
         one Observability per lane (None entries allowed);
-        ``before_round(r)`` runs at each round boundary — the control
-        plane applies queued knob swaps and cancellations there —
-        and ``after_round(r)`` after the round's lanes are recorded (the
+        ``before_round(step)`` runs at each group-step boundary — the
+        control plane applies queued knob swaps, cancellations, and lane
+        REFILLS there (``release_lane`` + ``install_lane``) — and
+        ``after_round(step)`` after the step's lanes are recorded (the
         control plane checkpoints there, reading ``self.paths_list``).
+        A lane that reaches its horizon is retired (``on_lane_done(i)``,
+        then its slot is free for refill); the loop exits when no lane is
+        active.
 
-        Resume: ``start_round=r`` with ``resume_paths[i]`` holding lane
-        i's checkpointed paths (entries through round r) continues a
-        crashed batch — the per-round ``fold_in`` keys make the suffix
-        bit-identical to the uninterrupted run.  Lanes with a None entry
-        start fresh (initial eval at index 0).
+        Resume: ``start_rounds[i]`` (or the uniform ``start_round``) with
+        ``resume_paths[i]`` holding lane i's checkpointed paths (entries
+        through its resume round) continues a crashed batch — the
+        per-round ``fold_in`` keys make the suffix bit-identical to the
+        uninterrupted run.  Lanes with a None entry start fresh (initial
+        eval at index 0).
 
         Quarantine: a lane whose params/variance go non-finite, whose
         eval returns a non-finite loss, or whose recording raises is
@@ -519,8 +678,7 @@ class BatchRunner:
         plane) while the surviving lanes continue — same lowering, no
         retrace."""
         log = log_fn or (lambda s: None)
-        obs_list = list(obs_list) if obs_list else [None] * self.n
-        cfg0 = self.cfgs[0]
+        self.obs_list = list(obs_list) if obs_list else [None] * self.n
         paths_list = [
             (
                 dict(resume_paths[i])
@@ -530,18 +688,36 @@ class BatchRunner:
             for i in range(self.n)
         ]
         self.paths_list = paths_list
-        prev_rung = [
+        self.lane_rounds = [
+            int(r) for r in (
+                start_rounds if start_rounds is not None
+                else [start_round] * self.n
+            )
+        ]
+        self._prev_rung = [
             int(t.defense_state[1][0]) if t.defense is not None else None
             for t in self.trainers
         ]
-        for r in range(start_round, cfg0.rounds):
+        # a lane resumed AT its horizon has nothing left to run
+        self._retire_done_lanes(on_lane_done)
+        step = min(self.lane_rounds)
+        while True:
             if before_round is not None:
-                before_round(r)
+                before_round(step)
+            # a lane REFILLED at/past its horizon (resumed from a
+            # final-round checkpoint) retires without running a round
+            self._retire_done_lanes(on_lane_done)
             if not any(self.active):
                 break
+            # each lane runs the next round of ITS OWN trajectory; a
+            # uniform group passes one scalar (the legacy surface), a
+            # mixed group the per-lane list — same [N] aval either way
+            rounds = list(self.lane_rounds)
+            uniform = len(set(rounds)) == 1
+            arg = rounds[0] if uniform else rounds
             before = self.retrace.count("batch_round_fn")
             t0 = time.perf_counter()
-            variance = self.run_round(r)
+            variance = self.run_round(arg)
             jax.block_until_ready(self.carry[0])
             compiled = self.retrace.count("batch_round_fn") > before
             dt = time.perf_counter() - t0
@@ -564,9 +740,10 @@ class BatchRunner:
             )
             sm_np = (
                 np.asarray(self.last_service_metrics)
-                if cfg0.service == "on" else None
+                if self.cfgs[0].service == "on" else None
             )
             for i in range(self.n):
+                r = rounds[i]
                 if not self.active[i]:
                     continue
                 if not np.isfinite(var_np[i]):
@@ -585,8 +762,8 @@ class BatchRunner:
                         None if fm_np is None else fm_np[i],
                         None if dm_np is None else dm_np[i],
                         None if sm_np is None else sm_np[i],
-                        dt, compiled, paths_list[i], obs_list[i], prev_rung,
-                        log,
+                        dt, compiled, paths_list[i], self.obs_list[i],
+                        self._prev_rung, log,
                     )
                 except Exception as exc:  # one lane's eval must not kill N-1
                     self._quarantine(
@@ -600,9 +777,31 @@ class BatchRunner:
                     self._quarantine(
                         i, r, "non-finite validation loss", on_quarantine, log
                     )
+            for i in range(self.n):
+                self.lane_rounds[i] = rounds[i] + 1
+            # retire lanes at their own horizon BEFORE after_round, so
+            # the control plane's checkpoint pass never writes a
+            # past-the-horizon checkpoint for a finished tenant (the run
+            # is terminal in the journal from on_lane_done on)
+            self._retire_done_lanes(on_lane_done)
             if after_round is not None:
-                after_round(r)
+                after_round(step)
+            step += 1
         return paths_list
+
+    def _retire_done_lanes(self, on_lane_done) -> None:
+        """Deactivate every lane at/past its own horizon, notifying the
+        control plane (a hook exception must not kill cotenants)."""
+        for i in range(self.n):
+            if self.active[i] and self.lane_rounds[i] >= self.cfgs[i].rounds:
+                self.active[i] = False
+                if on_lane_done is not None:
+                    try:
+                        on_lane_done(i)
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
 
     def _record_lane(
         self, i, r, var_f, fault_row, defense_row, service_row, dt,

@@ -1,0 +1,184 @@
+"""Elastic lane scheduling: mesh tenants in shared lanes, lane refill.
+
+Two serving gaps closed here, both on top of the hooks
+``serve/batch.py`` grew for this module (per-lane round indices,
+``release_lane`` / ``install_lane``):
+
+**Mesh/streamed tenants batch.**  The v1 server routed every
+``cohort_size > 0`` (and so every ``pop_shards > 1``) tenant solo,
+because the streamed iteration path Python-gates its cohort-scan
+structure on one batchable knob (``straggler_prob`` — see
+``fed/train.py _iteration_streamed``).  :func:`validate_stream_batch`
+lifts the carve-out by PINNING the gating knobs instead: they must be
+equal across the batch (``static_signature`` already folds them into a
+streamed config's digest, so unequal tenants never group), they trace
+as closure constants, and they are excluded from the stacked knob
+arrays and from hot-swap.  Everything else about the streamed round —
+the cohort scan, the quantile rungs, churn/deadline service state —
+vmaps unchanged, so N streamed tenants share ONE lowering exactly like
+resident ones.
+
+**The lane axis can shard over the device mesh.**  For mesh tenants
+(``pop_shards > 1`` — pod-scale streamed runs) the
+``backend="shard_vmap"`` tier wraps the vmapped element program in
+``shard_map`` over a 1-D ``lanes`` mesh (the SNIPPETS shard_map-
+wrapped-jit pattern; same jaxlib caveats as ``parallel/popmesh.py``:
+``check_rep=False`` required, carry donation through ``shard_map``
+unsound on the CPU client).  Each device owns ``n/ndev`` lanes of the
+same compiled program; inside a lane the sequential-engine trainer is
+bit-identical to the mesh engine by the ``ops/shardctx.py`` merge
+algebra, so sharding the lane axis changes placement, never math.
+When the device count does not divide the batch (or there is one
+device), the runner downgrades to plain ``vmap`` — same numbers,
+different placement.
+
+**Elastic refill.**  :func:`seat_order` reseats recovered tenants into
+their journal-hinted lanes (the mid-refill SIGKILL replay invariant:
+the same tenant lands in the same lane), and the RunManager's
+between-round refill path uses ``install_lane`` to splice a queued
+tenant into a drained/cancelled slot — one lowering per group shape
+for the whole group lifetime, refills included.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import obs as obs_lib
+from ..fed.config import FedConfig
+from . import batch as batch_lib
+from .batch import PINNED_STREAM_KNOBS, BatchRunner
+
+#: mesh axis name of the lane dimension (shard_vmap backend)
+LANE_AXIS = "lanes"
+
+
+def pinned_knobs(cfg: FedConfig) -> tuple:
+    """The batchable knobs this config family must PIN (equal across the
+    batch, not hot-swappable): the streamed path's Python-gated knobs
+    for ``cohort_size > 0`` tenants, nothing for resident ones."""
+    return PINNED_STREAM_KNOBS if cfg.cohort_size > 0 else ()
+
+
+def validate_stream_batch(cfgs: Sequence[FedConfig]) -> List[str]:
+    """The widened admission contract: everything
+    :func:`serve.batch.validate_batch` requires EXCEPT the streamed-
+    cohort carve-out, plus pinned-knob equality for streamed batches.
+    Returns the applicable traced-knob names minus the pinned ones."""
+    knobs = batch_lib._validate_structure(cfgs)
+    t = cfgs[0]
+    for knob in pinned_knobs(t):
+        vals = sorted({float(getattr(c, knob)) for c in cfgs})
+        if len(vals) > 1:
+            raise ValueError(
+                f"stream batch contract: knob {knob!r} gates the cohort "
+                f"scan's traced structure and must be PINNED (equal) "
+                f"across a streamed batch, got {vals}"
+            )
+    return [k for k in knobs if k not in pinned_knobs(t)]
+
+
+class ElasticBatchRunner(BatchRunner):
+    """BatchRunner admitting streamed/mesh tenants, optionally sharding
+    the lane axis over the device mesh (``backend="shard_vmap"``)."""
+
+    def __init__(
+        self,
+        cfgs: Sequence[FedConfig],
+        dataset=None,
+        retrace: Optional[obs_lib.RetraceDetector] = None,
+        backend: str = "vmap",
+        restore_fn=None,
+    ) -> None:
+        self._lane_mesh = None
+        if backend == "shard_vmap":
+            devs = jax.devices()
+            if len(devs) > 1 and len(cfgs) % len(devs) == 0:
+                self._lane_mesh = Mesh(np.asarray(devs), (LANE_AXIS,))
+            else:
+                # an indivisible batch (or a single device) downgrades
+                # to plain vmap: same numbers, different placement
+                backend = "vmap"
+        super().__init__(
+            cfgs, dataset=dataset, retrace=retrace, backend=backend,
+            restore_fn=restore_fn,
+        )
+
+    def _validate(self, cfgs: Sequence[FedConfig]) -> List[str]:
+        return validate_stream_batch(cfgs)
+
+    def _builder(self, backend: str):
+        if backend == "shard_vmap":
+            return self._build_shard_vmap
+        return super()._builder(backend)
+
+    def _donate_argnums(self) -> tuple:
+        # donating buffers through shard_map is unsound on this jaxlib's
+        # CPU client (parallel/popmesh.py's _round_donate_argnums)
+        if self._lane_mesh is not None and jax.default_backend() == "cpu":
+            return ()
+        return super()._donate_argnums()
+
+    def _build_shard_vmap(self):
+        mesh, spec = self._lane_mesh, P(LANE_AXIS)
+
+        def batched(carry, base_keys, knobs, round_idx):
+            return shard_map(
+                jax.vmap(self._one, in_axes=(0, 0, 0, 0)),
+                mesh=mesh,
+                in_specs=(spec, spec, spec, spec),
+                out_specs=spec,
+                check_rep=False,
+            )(carry, base_keys, knobs, round_idx)
+
+        return batched
+
+
+def runner_for(
+    cfgs: Sequence[FedConfig],
+    dataset=None,
+    retrace: Optional[obs_lib.RetraceDetector] = None,
+    backend: str = "vmap",
+    restore_fn=None,
+) -> BatchRunner:
+    """Build the right runner for a signature group: streamed/mesh
+    tenants get the elastic runner (mesh tenants upgrade ``vmap`` to
+    the lane-sharded ``shard_vmap`` tier), resident tenants the base
+    one — callers never pick a class by hand."""
+    cfg0 = cfgs[0]
+    if cfg0.cohort_size > 0 or cfg0.pop_shards > 1:
+        be = backend
+        if backend == "vmap" and cfg0.pop_shards > 1:
+            be = "shard_vmap"
+        return ElasticBatchRunner(
+            cfgs, dataset=dataset, retrace=retrace, backend=be,
+            restore_fn=restore_fn,
+        )
+    return BatchRunner(
+        cfgs, dataset=dataset, retrace=retrace, backend=backend,
+        restore_fn=restore_fn,
+    )
+
+
+def seat_order(runs: Sequence) -> List:
+    """Order a group's runs by lane: a run whose journal-replayed
+    ``lane_hint`` points at an unclaimed in-range slot is seated THERE
+    (deterministic replay: a refilled tenant must land back in the same
+    lane after a crash), the rest fill the remaining slots in
+    submission order."""
+    n = len(runs)
+    seats: List[Optional[object]] = [None] * n
+    rest = []
+    for run in runs:
+        hint = getattr(run, "lane_hint", None)
+        if hint is not None and 0 <= hint < n and seats[hint] is None:
+            seats[hint] = run
+        else:
+            rest.append(run)
+    it = iter(rest)
+    return [seat if seat is not None else next(it) for seat in seats]

@@ -15,6 +15,10 @@ Ops and their extra fields::
     running     —           (the scheduler picked the run up)
     checkpoint  round       (a durable per-round checkpoint landed)
     requeued    retries, reason   (watchdog bounded-backoff retry)
+    refill      lane, round, group_round, signature   (a drained lane's
+                slot reseated from the admission queue mid-group —
+                WRITTEN BEFORE the device splice, so a SIGKILL
+                mid-refill replays the same tenant into the same lane)
     completed   round, lowerings, final_val_acc?, final_val_loss?
     failed      round, reason
     cancelled   round
@@ -122,6 +126,13 @@ def replay(
         elif op == "requeued":
             st["status"] = "queued"
             st["retries"] = int(rec.get("retries", st["retries"]))
+        elif op == "refill":
+            # mid-group reseat: in-flight (requeue on replay), remember
+            # the lane so recovery seats the same tenant in the same
+            # slot; the resume round stays checkpoint-owned
+            st["status"] = "queued"
+            if rec.get("lane") is not None:
+                st["lane"] = int(rec["lane"])
         elif op in TERMINAL_OPS:
             st["status"] = op
             if rec.get("round") is not None:

@@ -9,12 +9,22 @@ A :class:`RunManager` owns the lifecycle of every submitted run:
   stream, and queues it under its :func:`~.batch.static_signature`.
 * The scheduler (a background thread started by :meth:`start`, or a
   direct :meth:`drain` call from tests) groups queued runs by
-  ``(signature, resume_round)`` and executes each group through ONE
-  shared :class:`~.batch.BatchRunner` — that grouping is what turns 64
-  tenant submissions into a single XLA lowering.  Streamed/mesh configs
-  (``cohort_size > 0`` or ``pop_shards > 1``), which the batch contract
-  rejects, run as SOLO single-lane groups through the ordinary
-  ``harness.run`` path instead of being refused.
+  ``static_signature`` and executes each group through ONE shared
+  runner (:func:`~.elastic.runner_for`) — that grouping is what turns
+  64 tenant submissions into a single XLA lowering.  Streamed and mesh
+  tenants batch too (the elastic runner PINS the cohort-scan gating
+  knobs instead of refusing them; mesh tenants shard the lane axis
+  over the device mesh) — only multi-round dispatch tiers
+  (``rounds_per_dispatch > 1``, whose R-round scan cannot join the
+  per-round group loop) still run SOLO through ``harness.run``.
+* Lane groups are ELASTIC: when a lane drains mid-group (completes its
+  own horizon, cancels, or quarantines) the slot is refilled between
+  rounds from the admission queue (same signature), the incoming
+  tenant resuming from its own checkpoint.  Each refill decision is a
+  journal record written BEFORE the device splice, so a SIGKILL
+  mid-refill replays the same tenant into the same lane; per-lane
+  round indices let every lane run its own horizon, and the group
+  retires only when no lane is live.
 * Between rounds (the BatchRunner's ``before_round`` hook) queued knob
   swaps and cancellations land; after each round (``after_round``) every
   live lane writes a durable checkpoint — params + opt carries + the
@@ -44,6 +54,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import sys
 import threading
 import time
@@ -54,8 +65,9 @@ from .. import obs as obs_lib
 from ..fed import checkpoint, harness
 from ..fed.config import FedConfig, config_from_mapping, config_to_mapping
 from ..utils import io as io_lib
+from . import elastic as elastic_lib
 from . import journal as journal_lib
-from .batch import BatchRunner, applicable_knobs, static_signature
+from .batch import applicable_knobs, static_signature
 
 #: terminal statuses — no further transitions, obs stream closed
 _DONE = ("completed", "cancelled", "failed")
@@ -84,6 +96,7 @@ class Run:
         self.status = "queued"
         self.round = 0  # last round boundary reached while running
         self.lane: Optional[int] = None
+        self.lane_hint: Optional[int] = None  # journal-replayed seat
         self.error: Optional[str] = None
         self.lowerings: Optional[int] = None
         self.swaps: List[tuple] = []  # pending (knob, value), applied between rounds
@@ -181,21 +194,43 @@ class RunManager:
         self._thread: Optional[threading.Thread] = None
         self._watchdog: Optional[threading.Thread] = None
         self._dataset_cache: Dict[str, Any] = {}
+        # scheduler-scope telemetry: lane_group / lane_refill events and
+        # the admission-queue gauge are group/service facts, not tenant
+        # ones, so they land UNLABELED on the shared registry
+        self._sched = (
+            obs_lib.Observability(obs_lib.MetricsSink(registry))
+            if registry is not None
+            else obs_lib.NULL
+        )
 
     # ---------------------------------------------------------- registry
 
     @staticmethod
     def _is_solo(cfg: FedConfig) -> bool:
-        """Streamed cohorts, population meshes and multi-round dispatch
-        tiers fall outside the batch contract (validate_batch; the
-        BatchRunner owns its own per-round loop, which an R-round scan
-        cannot join) — schedule them as solo single-lane groups through
-        the harness path instead of rejecting them."""
-        return (
-            cfg.cohort_size > 0
-            or cfg.pop_shards > 1
-            or cfg.rounds_per_dispatch > 1
-        )
+        """Only genuinely unbatchable semantics fall outside the batch
+        contract now: multi-round dispatch tiers (the group loop is
+        per-round; an R-round scan cannot join it) and service-mode warm
+        rollback (it restores per-run host state outside the shared
+        batch carry).  Streamed cohorts and population meshes batch
+        through the elastic runner (``serve/elastic.py``), which pins
+        their trace-gating knobs instead of refusing them."""
+        if cfg.rounds_per_dispatch > 1:
+            return True
+        return cfg.service == "on" and cfg.rollback != "off"
+
+    def _queue_depth(self) -> int:
+        """Runs awaiting admission to a lane (caller holds the lock)."""
+        return sum(1 for r in self._runs.values() if r.status == "queued")
+
+    def _gauge_queue(self) -> None:
+        """Refresh the admission-queue-depth gauge (caller holds the
+        lock; the registry itself is thread-safe)."""
+        if self.registry is not None:
+            self.registry.set(
+                "aircomp_admission_queue_depth",
+                float(self._queue_depth()),
+                help_text="runs queued for admission to a lane group",
+            )
 
     def _open_obs(self, run_id: str, cfg: FedConfig, title: str):
         sink: obs_lib.EventSink = obs_lib.JsonlSink(
@@ -263,6 +298,7 @@ class RunManager:
             self._runs[run_id] = run
             self._order.append(run_id)
             self._pending.append(run_id)
+            self._gauge_queue()
         self._wake.set()
         return run_id
 
@@ -307,6 +343,7 @@ class RunManager:
                 run.obs.emit("run_cancelled", run_id=run_id, round=0)
                 run.obs.close()
                 self.journal.append("cancelled", run_id, round=run.round)
+                self._gauge_queue()
             return run.info()
 
     def swap(self, run_id: str, knob: str, value) -> Dict[str, Any]:
@@ -321,12 +358,14 @@ class RunManager:
                     f"run {run_id} is {run.status}; knobs can only be "
                     f"swapped on queued/running runs"
                 )
-            allowed = applicable_knobs(run.cfg)
+            allowed = set(applicable_knobs(run.cfg)) - set(
+                elastic_lib.pinned_knobs(run.cfg)
+            )
             if knob not in allowed:
                 raise ValueError(
                     f"knob {knob!r} is not hot-swappable for this run "
                     f"(batchable here: {sorted(allowed)}); structural "
-                    f"knobs need a new run"
+                    f"and stream-pinned knobs need a new run"
                 )
             value = float(value)
             if run.status == "queued":
@@ -387,6 +426,12 @@ class RunManager:
                     )
                     run.lowerings = st.get("lowerings")
                     run.error = st.get("error")
+                    if status == "completed":
+                        # re-adopt the on-disk record so a restarted
+                        # server still serves completed runs' artifacts
+                        path = harness.cache_path(cfg, cfg.dataset)
+                        if os.path.exists(path):
+                            run.record_path = path
                     if (
                         st.get("final_val_acc") is not None
                         or st.get("final_val_loss") is not None
@@ -400,6 +445,10 @@ class RunManager:
                     run.resume_round = self._probe_resume(run, warn)
                     run.round = run.resume_round
                     run.status = "queued"
+                    if st.get("lane") is not None:
+                        # journaled refill seat: recovery must reseat
+                        # this tenant into the same lane (seat_order)
+                        run.lane_hint = int(st["lane"])
                     run.obs = self._open_obs(run_id, cfg, run.title)
                     run.obs.emit(
                         "journal_replay",
@@ -411,6 +460,7 @@ class RunManager:
                     requeued.append(run_id)
                 self._runs[run_id] = run
                 self._order.append(run_id)
+            self._gauge_queue()
         if requeued:
             self._wake.set()
         return requeued
@@ -478,6 +528,7 @@ class RunManager:
                 run = self._runs[rid]
                 if run.status not in _DONE:
                     run.obs.close()
+        self._sched.close()
         self.journal.close()
 
     def _loop(self) -> None:
@@ -495,10 +546,14 @@ class RunManager:
 
     def drain(self) -> None:
         """Execute every currently-queued run: solo configs one at a
-        time, batchable ones grouped by ``(signature, resume_round)``
-        into one BatchRunner per group.  Blocks until done.  Tests call
-        this directly for deterministic grouping; the scheduler thread
-        calls it after the batch window."""
+        time, batchable ones grouped by ``signature`` into one elastic
+        runner per group (each lane resumes from its OWN checkpoint
+        round, so mixed-progress tenants still share a lowering).
+        Blocks until done.  Tests call this directly for deterministic
+        grouping; the scheduler thread calls it after the batch
+        window.  Runs left queued (submitted mid-drain) are picked up
+        either by a group's between-round refill or by the next loop
+        iteration."""
         while True:
             with self._lock:
                 pending = [
@@ -508,7 +563,7 @@ class RunManager:
                 ]
                 self._pending = []
                 solos: List[Run] = []
-                groups: Dict[Tuple[str, int], List[Run]] = {}
+                groups: Dict[str, List[Run]] = {}
                 for run in pending:
                     run.status = "running"
                     run.attempt += 1
@@ -517,9 +572,8 @@ class RunManager:
                     if run.solo:
                         solos.append(run)
                     else:
-                        groups.setdefault(
-                            (run.signature, run.resume_round), []
-                        ).append(run)
+                        groups.setdefault(run.signature, []).append(run)
+                self._gauge_queue()
             if not groups and not solos:
                 return
             for runs in groups.values():
@@ -552,42 +606,42 @@ class RunManager:
                     )
                 run.obs.close()
 
-    def _load_group_resume(
-        self, runs: List[Run], resume_round: int
-    ) -> Tuple[int, List[Optional[tuple]], List[Optional[Dict[str, list]]]]:
-        """Load every lane's checkpoint for a resuming group.  All-or-
-        nothing: if ANY lane's checkpoint is unusable the whole group
-        restarts from round 0 (a fresh replay is bit-identical by the
-        fold_in key discipline — correctness never depends on the
-        checkpoint, only wall-clock does)."""
-        if resume_round <= 0:
-            return 0, [None] * len(runs), [None] * len(runs)
-        restores: List[Optional[tuple]] = []
-        paths: List[Optional[Dict[str, list]]] = []
-        for run in runs:
-            try:
-                restored = checkpoint.load(run.cfg.checkpoint_dir, run.title)
-                meta = checkpoint.load_meta(run.cfg.checkpoint_dir, run.title)
-            except Exception as exc:
-                _warn(
-                    f"run {run.run_id}: checkpoint unreadable at group time "
-                    f"({type(exc).__name__}: {exc}); group restarts fresh"
-                )
-                restored, meta = None, None
-            if (
-                restored is None
-                or int(restored[0]) != resume_round
-                or meta is None
-            ):
-                return 0, [None] * len(runs), [None] * len(runs)
-            restores.append(restored)
-            paths.append(json.loads(meta))
-        return resume_round, restores, paths
+    def _load_lane_resume(
+        self, run: Run
+    ) -> Tuple[int, Optional[tuple], Optional[Dict[str, list]]]:
+        """One lane's durable resume state: ``(round, restored, paths)``
+        — or ``(0, None, None)`` when there is no usable checkpoint
+        (absent, torn, round-mismatched, or missing the paths meta).
+        Per-lane: an elastic group resumes each lane independently, so
+        one torn checkpoint restarts ONE lane, never the group (a fresh
+        replay is bit-identical by the fold_in key discipline —
+        correctness never depends on the checkpoint, only wall-clock
+        does)."""
+        if run.resume_round <= 0:
+            return 0, None, None
+        try:
+            restored = checkpoint.load(run.cfg.checkpoint_dir, run.title)
+            meta = checkpoint.load_meta(run.cfg.checkpoint_dir, run.title)
+        except Exception as exc:
+            _warn(
+                f"run {run.run_id}: checkpoint unreadable at seat time "
+                f"({type(exc).__name__}: {exc}); lane restarts fresh"
+            )
+            return 0, None, None
+        if (
+            restored is None
+            or int(restored[0]) != run.resume_round
+            or meta is None
+        ):
+            return 0, None, None
+        return run.resume_round, restored, json.loads(meta)
 
     def _run_group(self, runs: List[Run]) -> None:
-        resume_round, restores, resume_paths = self._load_group_resume(
-            runs, runs[0].resume_round
-        )
+        runs = elastic_lib.seat_order(runs)
+        lane_resume = [self._load_lane_resume(run) for run in runs]
+        start_rounds = [rr for rr, _, _ in lane_resume]
+        restores = [restored for _, restored, _ in lane_resume]
+        resume_paths = [paths for _, _, paths in lane_resume]
         try:
             dataset = self._dataset_for(runs[0].cfg.dataset)
 
@@ -597,20 +651,32 @@ class RunManager:
                         trainer, runs[lane].cfg, restores[lane], log_fn=_warn
                     )
 
-            batch = BatchRunner(
+            batch = elastic_lib.runner_for(
                 [r.cfg for r in runs],
                 dataset=dataset,
                 backend=self._backend,
-                restore_fn=restore_fn if resume_round > 0 else None,
+                restore_fn=(
+                    restore_fn
+                    if any(r is not None for r in restores)
+                    else None
+                ),
             )
         except Exception as exc:
             self._fail(runs, exc)
             return
+        # seated[lane] is the lane's CURRENT occupant (None = drained
+        # slot awaiting refill); group_runs accumulates every run that
+        # ever rode this batch, so a group-level exception fails the
+        # refilled tenants too
+        seated: List[Optional[Run]] = list(runs)
+        group_runs: List[Run] = list(runs)
         attempts = {run.run_id: run.attempt for run in runs}
-        lane_of = {run.run_id: lane for lane, run in enumerate(runs)}
         with self._lock:
             for lane, run in enumerate(runs):
                 run.lane = lane
+                run.lane_hint = lane
+                run.resume_round = start_rounds[lane]
+                run.round = start_rounds[lane]
 
         def _live(run: Run) -> bool:
             """Still this group's run?  A watchdog requeue bumps the
@@ -620,22 +686,138 @@ class RunManager:
                 and run.attempt == attempts[run.run_id]
             )
 
-        def before_round(rnd: int) -> None:
+        def _release(lane: int) -> None:
+            # free the slot AND the lane's forensic state (quarantine
+            # freeze / failure reason), so a refilled tenant never
+            # inherits the prior occupant's counters
+            batch.release_lane(lane)
+            seated[lane] = None
+
+        def install(lane: int, run: Run, step: int) -> None:
+            """Seat a queued tenant into a drained lane (lock held)."""
+            run.status = "running"
+            run.attempt += 1
+            attempts[run.run_id] = run.attempt
+            run.lane = lane
+            run.lane_hint = lane
+            run.wedged = False
+            run.last_progress = time.time()
+            rr, restored, rpaths = self._load_lane_resume(run)
+            # WAL discipline: the refill record lands BEFORE the device
+            # splice, so a SIGKILL between the two replays this tenant
+            # back into this exact lane (recover() turns the journaled
+            # lane into a seat_order hint)
+            self.journal.append(
+                "refill", run.run_id,
+                lane=lane, round=rr, group_round=step,
+                signature=run.signature,
+            )
+            try:
+                batch.install_lane(
+                    lane, run.cfg, own_round=rr,
+                    restored=restored, paths=rpaths,
+                )
+            except Exception as exc:
+                run.status = "failed"
+                run.error = f"{type(exc).__name__}: {exc}"
+                run.obs.emit(
+                    "run_failed",
+                    run_id=run.run_id, round=rr, reason=run.error,
+                )
+                run.obs.close()
+                self.journal.append(
+                    "failed", run.run_id, round=rr, reason=run.error,
+                )
+                return
+            batch.obs_list[lane] = run.obs
+            run.resume_round = rr
+            run.round = rr
+            seated[lane] = run
+            group_runs.append(run)
+            run.obs.emit(
+                "lane_refill",
+                run_id=run.run_id, lane=lane, round=rr, group_round=step,
+            )
+            self._sched.emit(
+                "lane_refill",
+                run_id=run.run_id, lane=lane, round=rr, group_round=step,
+            )
+
+        def refill(step: int) -> None:
+            """Between rounds, reseat drained lanes from the admission
+            queue (lock held): same-signature queued tenants only, the
+            journal-hinted ones reclaiming their exact lane first, the
+            rest zipping into the remaining slots in submission
+            order."""
+            free = [ln for ln in range(batch.n) if seated[ln] is None]
+            if not free:
+                return
+            sig = runs[0].signature
+            picks: List[Run] = []
+            keep: List[str] = []
+            for rid in self._pending:
+                cand = self._runs[rid]
+                if (
+                    len(picks) < len(free)
+                    and cand.status == "queued"
+                    and not cand.solo
+                    and cand.signature == sig
+                    and not cand.cancel_requested
+                ):
+                    picks.append(cand)
+                else:
+                    keep.append(rid)
+            if not picks:
+                return
+            self._pending = keep
+            free_set = set(free)
+            hinted: List[Tuple[int, Run]] = []
+            rest: List[Run] = []
+            for cand in picks:
+                h = cand.lane_hint
+                if h is not None and h in free_set:
+                    hinted.append((h, cand))
+                    free_set.discard(h)
+                else:
+                    rest.append(cand)
+            open_lanes = iter(sorted(free_set))
+            for lane, cand in hinted + [
+                (next(open_lanes), c) for c in rest
+            ]:
+                install(lane, cand, step)
+            self._gauge_queue()
+
+        def emit_lane_group(step: int) -> None:
+            # occupancy is the acceptance gauge: live lanes / group
+            # width, sampled every round boundary after refill
+            live = sum(1 for ln in range(batch.n) if seated[ln] is not None)
+            self._sched.emit(
+                "lane_group",
+                round=step, lanes=batch.n, live=live,
+                occupancy=live / batch.n,
+                queue_depth=self._queue_depth(),
+            )
+
+        def before_round(step: int) -> None:
             with self._lock:
-                for run in runs:
-                    lane = lane_of[run.run_id]
+                for lane in range(batch.n):
+                    run = seated[lane]
+                    if run is None:
+                        continue
                     if not _live(run):
-                        if batch.active[lane]:
-                            batch.cancel(lane)
+                        # terminal elsewhere (quarantined, watchdog-
+                        # failed) or re-adopted: free the slot
+                        _release(lane)
                         continue
                     if run.wedged:
                         # the watchdog owns this run now (requeue or
                         # terminal failure) — this group just stops
                         # driving the lane, without terminalizing
-                        batch.cancel(lane)
+                        _release(lane)
                         continue
+                    rnd = batch.lane_rounds[lane]
                     if run.cancel_requested:
-                        batch.cancel(lane)
+                        _release(lane)
                         run.status = "cancelled"
                         run.obs.emit(
                             "run_cancelled", run_id=run.run_id, round=rnd
@@ -660,11 +842,13 @@ class RunManager:
                     run.swaps = []
                     run.round = rnd
                     run.last_progress = time.time()
+                refill(step)
+                emit_lane_group(step)
 
         def on_quarantine(lane: int, rnd: int, reason: str) -> None:
             with self._lock:
-                run = runs[lane]
-                if not _live(run):
+                run = seated[lane]
+                if run is None or not _live(run):
                     return
                 run.status = "failed"
                 run.error = f"quarantined: {reason}"
@@ -678,21 +862,28 @@ class RunManager:
                     "failed", run.run_id, round=rnd, reason=run.error
                 )
 
-        def after_round(rnd: int) -> None:
+        def after_round(step: int) -> None:
             # durable per-round progress: params + opt carries + the
             # metric paths so far, one atomic npz per live lane — the
-            # unit a restarted server resumes from
+            # unit a restarted server resumes from.  lane_rounds has
+            # already advanced past the round just run, so it IS the
+            # boundary a restart resumes from.
             with self._lock:
-                for run in runs:
-                    lane = lane_of[run.run_id]
-                    if not _live(run) or not batch.active[lane]:
+                for lane in range(batch.n):
+                    run = seated[lane]
+                    if (
+                        run is None
+                        or not _live(run)
+                        or not batch.active[lane]
+                    ):
                         continue
+                    rnd = batch.lane_rounds[lane]
                     flat, extras = batch.lane_state(lane)
                     try:
                         checkpoint.save(
                             run.cfg.checkpoint_dir,
                             run.title,
-                            rnd + 1,
+                            rnd,
                             flat,
                             extras,
                             meta=json.dumps(batch.paths_list[lane]),
@@ -704,35 +895,22 @@ class RunManager:
                         )
                         continue
                     self.journal.append(
-                        "checkpoint", run.run_id, round=rnd + 1
+                        "checkpoint", run.run_id, round=rnd
                     )
-                    run.round = rnd + 1
+                    run.round = rnd
                     run.last_progress = time.time()
 
-        try:
-            paths_list = batch.train(
-                obs_list=[r.obs for r in runs],
-                start_round=resume_round,
-                before_round=before_round,
-                after_round=after_round,
-                resume_paths=resume_paths,
-                on_quarantine=on_quarantine,
-            )
-        except Exception as exc:
-            self._fail(runs, exc)
-            return
-        lowerings = batch.retrace.count("batch_round_fn")
-        dataset = self._dataset_for(runs[0].cfg.dataset)
-        with self._lock:
-            for run, paths in zip(runs, paths_list):
-                if not _live(run) or run.wedged:
-                    # wedged runs belong to the watchdog now (their lane
-                    # went dark mid-schedule, so these paths are partial)
-                    if run.status in _DONE:
-                        run.lowerings = run.lowerings or lowerings
-                    continue
+        def on_lane_done(lane: int) -> None:
+            # a lane reached its OWN horizon: finalize the tenant now
+            # (record, journal, stream close) so the slot refills at
+            # the next round boundary while cotenants keep going
+            with self._lock:
+                run = seated[lane]
+                if run is None or not _live(run) or run.wedged:
+                    return
+                paths = batch.paths_list[lane]
                 run.paths = paths
-                run.lowerings = lowerings
+                run.lowerings = batch.retrace.count("batch_round_fn")
                 run.status = "completed"
                 run.wedged = False
                 run.round = run.cfg.rounds
@@ -756,11 +934,35 @@ class RunManager:
                     "completed",
                     run.run_id,
                     round=run.round,
-                    lowerings=lowerings,
+                    lowerings=run.lowerings,
                     final_val_acc=paths["valAccPath"][-1],
                     final_val_loss=paths["valLossPath"][-1],
                 )
                 run.obs.close()
+                seated[lane] = None
+
+        try:
+            batch.train(
+                obs_list=[r.obs for r in runs],
+                start_rounds=start_rounds,
+                before_round=before_round,
+                after_round=after_round,
+                resume_paths=resume_paths,
+                on_quarantine=on_quarantine,
+                on_lane_done=on_lane_done,
+            )
+        except Exception as exc:
+            self._fail(group_runs, exc)
+            return
+        lowerings = batch.retrace.count("batch_round_fn")
+        with self._lock:
+            for run in group_runs:
+                # lanes finalized early (mid-group retirement) recorded
+                # their lowering count as of that round; backfill the
+                # group-final count so every tenant reports the shared
+                # program's true total
+                if run.status == "completed" and run.lowerings is None:
+                    run.lowerings = lowerings
 
     def _run_solo(self, run: Run) -> None:
         """One streamed/mesh tenant through the ordinary harness path —
@@ -941,6 +1143,7 @@ class RunManager:
                 run.resume_round = self._probe_resume(run)
                 run.round = run.resume_round
                 self._pending.append(rid)
+                self._gauge_queue()
                 wake = True
         if wake:
             self._wake.set()

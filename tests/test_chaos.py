@@ -219,6 +219,38 @@ def test_journal_replay_folds_lifecycle(tmp_path):
     assert len(warnings) == 1  # the torn line, once
 
 
+def test_journal_replay_folds_refill_seat(tmp_path):
+    """The elastic-refill WAL discipline: a 'refill' record journaled
+    BEFORE the device splice folds to a queued run carrying its seat,
+    so a crash anywhere after the write replays the same tenant into
+    the same lane (serve/elastic.py seat_order)."""
+    from byzantine_aircomp_tpu.serve import journal as journal_lib
+
+    path = str(tmp_path / "journal.jsonl")
+    j = journal_lib.RunJournal(path)
+    cfg_map = config_to_mapping(_cfg(seed=5))
+    j.append("submitted", "run-0004", config=cfg_map, signature="sig",
+             title="t4", solo=False)
+    # the scheduler picked run-0004 to refill lane 2 at group round 3,
+    # then the process died before (or during) install_lane
+    j.append("refill", "run-0004", lane=2, round=0, group_round=3,
+             signature="sig")
+    j.close()
+    states = journal_lib.replay(path)
+    st = states["run-0004"]
+    assert st["status"] == "queued"
+    assert st["lane"] == 2
+    # a refill that got as far as 'running' + a checkpoint still keeps
+    # the seat for replay
+    j = journal_lib.RunJournal(path)
+    j.append("running", "run-0004")
+    j.append("checkpoint", "run-0004", round=1)
+    j.close()
+    st = journal_lib.replay(path)["run-0004"]
+    assert st["status"] == "queued" and st["round"] == 1
+    assert st["lane"] == 2
+
+
 def test_journal_replay_drops_configless_run(tmp_path):
     """A run whose 'submitted' line was itself the torn tail is
     unrecoverable — replay drops it with a warning, never raises."""

@@ -62,6 +62,11 @@ GOLDEN = {
     # tune_generation / tune_result (ASHA generation trail + winning
     # constants — tune/tuner.py, docs/DESIGN.md "Tuning the defense")
     8: "15428fa8563bc0c9",
+    # v9 added the elastic-scheduling kinds lane_group / lane_refill
+    # (per-round group occupancy samples + mid-group lane reseats —
+    # serve/runs.py, serve/elastic.py, docs/SERVING.md "Elastic lane
+    # groups")
+    9: "78db1defadd3c80a",
 }
 
 

@@ -302,51 +302,232 @@ def test_http_429_and_idempotency_key(tmp_path, synthetic_mnist):
         srv.manager.close()
 
 
-def test_streamed_config_runs_solo(tmp_path, synthetic_mnist):
-    """A streamed-cohort config (cohort_size > 0) — which the batch
-    contract rejects — is accepted and scheduled as a SOLO single-lane
-    group through the harness path (docs/SERVING.md)."""
+def _stream_cfg(**kw):
+    """A streamed-cohort config family (service mode, churny
+    population).  ``rollback="off"`` because warm rollback restores
+    per-run host state outside the shared batch carry — that semantic
+    is the one remaining solo carve-out."""
+    base = dict(
+        honest_size=12, byz_size=4, rounds=2, agg="median",
+        attack="gaussian", noise_var=0.1, service="on",
+        population=48, churn_arrival=0.05, churn_departure=0.02,
+        straggler_prob=0.2, cohort_size=2, rollback="off",
+    )
+    base.update(kw)
+    return _cfg(**base)
+
+
+def test_streamed_tenants_batch_one_lowering(tmp_path, synthetic_mnist):
+    """Streamed-cohort tenants (cohort_size > 0) — solo-only in v1 —
+    now BATCH through the elastic runner: the cohort scan's trace-gating
+    knobs are pinned instead of refused, so same-signature streamed
+    tenants share one lowering (docs/SERVING.md "Elastic lane
+    groups")."""
     from byzantine_aircomp_tpu.serve.runs import RunManager
 
     mgr = RunManager(str(tmp_path / "root"))
-    # sharded=False: the 8-device testbed would otherwise auto-shard the
-    # solo run and reject cohort_size=2 on the 8-wide clients axis
-    rid = mgr.submit(
-        _cfg(
-            honest_size=12, byz_size=4, rounds=2, agg="median",
-            attack="gaussian", noise_var=0.1, service="on",
-            population=48, churn_arrival=0.05, churn_departure=0.02,
-            straggler_prob=0.2, cohort_size=2, sharded=False, seed=1,
-        )
-    )
-    assert mgr.get(rid)["solo"] is True
+    # sharded=False: the 8-device testbed would otherwise auto-shard and
+    # reject cohort_size=2 on the 8-wide clients axis
+    ids = [mgr.submit(_stream_cfg(sharded=False, seed=s)) for s in (1, 2)]
+    infos = [mgr.get(rid) for rid in ids]
+    assert all(i.get("solo") is not True for i in infos)
+    assert len({i["signature"] for i in infos}) == 1  # one group
     mgr.drain()
-    info = mgr.get(rid)
-    assert info["status"] == "completed", info
-    assert info["lowerings"] == 1
-    assert info["val_acc"] is not None
-    assert os.path.exists(info["record"])
+    for rid in ids:
+        info = mgr.get(rid)
+        assert info["status"] == "completed", info
+        assert info["lowerings"] == 1
+        assert info["val_acc"] is not None
+        assert os.path.exists(info["record"])
 
 
-def test_mesh_tenant_runs_solo(tmp_path, synthetic_mnist):
-    """A population-mesh config (pop_shards > 1) is likewise a solo
-    single-lane group instead of a rejection."""
+def test_stream_signature_pins_gating_knobs(synthetic_mnist):
+    """Two streamed tenants that differ in a PINNED knob
+    (straggler_prob gates the cohort scan's traced structure) must land
+    in different signature groups; the stream contract also refuses
+    them outright if forced into one batch."""
+    from byzantine_aircomp_tpu.serve.batch import static_signature
+    from byzantine_aircomp_tpu.serve.elastic import validate_stream_batch
+
+    a = _stream_cfg(sharded=False, seed=1)
+    b = _stream_cfg(sharded=False, seed=2, straggler_prob=0.4)
+    assert static_signature(a) != static_signature(b)
+    with pytest.raises(ValueError, match="straggler_prob"):
+        validate_stream_batch([a, b])
+    # seed-only streamed pair: same group, knob list excludes the pin
+    knobs = validate_stream_batch(
+        [a, _stream_cfg(sharded=False, seed=2)]
+    )
+    assert "straggler_prob" not in knobs and "gamma" in knobs
+
+
+def test_mesh_tenants_shard_vmap_batch(tmp_path, synthetic_mnist):
+    """Population-mesh tenants (pop_shards > 1) batch with the lane
+    axis sharded over the 8-device testbed mesh (backend="shard_vmap"):
+    8 tenants, one lane per device, one lowering each."""
     from byzantine_aircomp_tpu.serve.runs import RunManager
 
     mgr = RunManager(str(tmp_path / "root"))
-    rid = mgr.submit(
-        _cfg(
-            honest_size=12, byz_size=4, rounds=2, agg="median",
-            attack="gaussian", noise_var=0.1, service="on",
-            population=48, churn_arrival=0.05, churn_departure=0.02,
-            straggler_prob=0.2, cohort_size=2, pop_shards=8, seed=1,
-        )
-    )
-    assert mgr.get(rid)["solo"] is True
+    ids = [mgr.submit(_stream_cfg(pop_shards=8, seed=s)) for s in range(8)]
+    assert all(mgr.get(rid).get("solo") is not True for rid in ids)
     mgr.drain()
-    info = mgr.get(rid)
-    assert info["status"] == "completed", info
-    assert os.path.exists(info["record"])
+    for rid in ids:
+        info = mgr.get(rid)
+        assert info["status"] == "completed", info
+        assert info["lowerings"] == 1
+        assert os.path.exists(info["record"])
+
+
+def test_warm_rollback_service_stays_solo(tmp_path, synthetic_mnist):
+    """The one semantic that cannot join a batch: service-mode warm
+    rollback restores per-run host state outside the shared carry, so
+    those tenants keep the solo single-lane path.  No drain — the flag
+    is decided at admission."""
+    from byzantine_aircomp_tpu.serve.runs import RunManager
+
+    mgr = RunManager(str(tmp_path / "root"))
+    rid = mgr.submit(_stream_cfg(sharded=False, rollback="on", seed=1))
+    assert mgr.get(rid)["solo"] is True
+    mgr.close()
+
+
+# ------------------------------------------------- elastic refill
+
+
+def test_lane_refill_bit_identical_one_lowering(tmp_path, synthetic_mnist):
+    """A tenant submitted mid-drain refills the first drained lane at a
+    round boundary: the group keeps its single lowering, and the
+    refilled tenant's record is bit-identical to the same config run in
+    an undisturbed manager (the occupancy acceptance bar of the elastic
+    scheduler)."""
+    from byzantine_aircomp_tpu.serve.batch import BatchRunner
+    from byzantine_aircomp_tpu.serve.runs import RunManager
+
+    mgr = RunManager(str(tmp_path / "root"))
+    a = mgr.submit(_cfg(rounds=2, seed=31))
+    b = mgr.submit(_cfg(rounds=6, seed=32))
+
+    late: list = []
+    orig_run_round = BatchRunner.run_round
+
+    def submitting_run_round(self, round_idx):
+        if not late:
+            late.append(mgr.submit(_cfg(rounds=4, seed=33)))
+        return orig_run_round(self, round_idx)
+
+    BatchRunner.run_round = submitting_run_round
+    try:
+        mgr.drain()
+    finally:
+        BatchRunner.run_round = orig_run_round
+    c = late[0]
+    for rid in (a, b, c):
+        info = mgr.get(rid)
+        assert info["status"] == "completed", info
+        assert info["lowerings"] == 1
+    # the reseat is journaled in the refilled run's own audit stream
+    run_dir = tmp_path / "root" / c
+    events_file = next(
+        f for f in os.listdir(run_dir) if f.endswith(".events.jsonl")
+    )
+    events = [json.loads(l) for l in open(run_dir / events_file)]
+    refills = [e for e in events if e["kind"] == "lane_refill"]
+    assert len(refills) == 1
+    assert refills[0]["run_id"] == c and refills[0]["round"] == 0
+
+    control = RunManager(str(tmp_path / "control"))
+    cc = control.submit(_cfg(rounds=4, seed=33))
+    control.drain()
+    x = pickle.load(open(mgr.get(c)["record"], "rb"))
+    y = pickle.load(open(control.get(cc)["record"], "rb"))
+    x.pop("roundsPerSec")
+    y.pop("roundsPerSec")
+    assert pickle.dumps(x) == pickle.dumps(y)
+
+
+def test_mid_refill_kill_replays_same_seat(tmp_path, synthetic_mnist):
+    """SIGKILL lands between the journal's refill record and the device
+    splice: replay must reseat the SAME tenant into the SAME lane and
+    the subsequent records must be bit-identical to a never-crashed
+    manager (the WAL discipline of the refill path)."""
+    from byzantine_aircomp_tpu.serve.batch import BatchRunner
+    from byzantine_aircomp_tpu.serve.runs import RunManager
+
+    root = str(tmp_path / "root")
+    crashed = RunManager(root)
+    a = crashed.submit(_cfg(rounds=2, seed=31))
+    b = crashed.submit(_cfg(rounds=6, seed=32))
+
+    late: list = []
+    orig_run_round = BatchRunner.run_round
+    orig_install = BatchRunner.install_lane
+    armed = [True]
+
+    def submitting_run_round(self, round_idx):
+        if not late:
+            late.append(crashed.submit(_cfg(rounds=4, seed=33)))
+        return orig_run_round(self, round_idx)
+
+    def dying_install(self, lane, cfg, **kw):
+        if armed[0]:
+            raise KeyboardInterrupt  # SIGKILL stand-in, after the WAL write
+        return orig_install(self, lane, cfg, **kw)
+
+    BatchRunner.run_round = submitting_run_round
+    BatchRunner.install_lane = dying_install
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            crashed.drain()
+    finally:
+        BatchRunner.run_round = orig_run_round
+        BatchRunner.install_lane = orig_install
+        armed[0] = False
+    c = late[0]
+    # A drained its 2 rounds and completed before the refill attempt
+    assert crashed.get(a)["status"] == "completed"
+
+    healed = RunManager(root)
+    requeued = healed.recover()
+    assert sorted(requeued) == sorted([b, c])
+    healed.drain()
+    for rid in (b, c):
+        info = healed.get(rid)
+        assert info["status"] == "completed", info
+        assert info["lowerings"] == 1
+    # same tenant, same lane: C reseats into A's drained slot (lane 0)
+    assert healed.get(c)["lane"] == 0
+
+    control = RunManager(str(tmp_path / "control"))
+    control_ids = [
+        control.submit(_cfg(rounds=r, seed=s))
+        for r, s in ((2, 31), (6, 32), (4, 33))
+    ]
+    control.drain()
+    for rid, crid in zip((a, b, c), control_ids):
+        x = pickle.load(open(healed.get(rid)["record"], "rb"))
+        y = pickle.load(open(control.get(crid)["record"], "rb"))
+        x.pop("roundsPerSec")
+        y.pop("roundsPerSec")
+        assert pickle.dumps(x) == pickle.dumps(y), rid
+
+
+def test_release_lane_clears_forensic_state(synthetic_mnist):
+    """Cancel-then-refill contamination: releasing a lane (the cancel
+    path) must clear its quarantine/strike bookkeeping so a reseated
+    tenant never inherits the prior occupant's forensic counters."""
+    from byzantine_aircomp_tpu.serve.batch import BatchRunner
+
+    batch = BatchRunner([_cfg(rounds=4, seed=1), _cfg(rounds=4, seed=2)])
+    batch.run_round(0)
+    batch._quarantine(1, 0, "poisoned", None, lambda s: None)
+    assert batch.failed == {1: "poisoned"} and not batch.active[1]
+    batch.release_lane(1)
+    assert 1 not in batch.failed
+    batch.install_lane(1, _cfg(rounds=4, seed=3))
+    assert batch.active[1] and 1 not in batch.failed
+    assert batch.refills == 1
+    batch.run_round(1)  # the reseated lane rides the same lowering
+    assert batch.retrace.count("batch_round_fn") == 1
 
 
 def test_server_resume_bit_identity_through_checkpoints(
