@@ -511,7 +511,7 @@ class Root:
     """One aggregation-root subprocess on an ephemeral port."""
 
     def __init__(self, topo: str, obs_dir: str, log_path: str,
-                 linger: float = 3.0):
+                 linger: float = 3.0, extra: Optional[List[str]] = None):
         self.log_path = log_path
         self._log_fh = open(log_path, "ab")
         self.proc = subprocess.Popen(
@@ -519,6 +519,7 @@ class Root:
                 sys.executable, "-m", "byzantine_aircomp_tpu", "root",
                 "--config", topo, "--host", "127.0.0.1", "--port", "0",
                 "--obs-dir", obs_dir, "--linger", str(linger),
+                *(extra or []),
             ],
             stdout=self._log_fh,
             stderr=subprocess.STDOUT,
@@ -602,7 +603,8 @@ class EdgeProc:
     """One edge subprocess bound to a shard of the topology."""
 
     def __init__(self, topo: str, shard: int, root_url: str,
-                 obs_dir: str, log_path: str):
+                 obs_dir: str, log_path: str,
+                 extra: Optional[List[str]] = None):
         self.shard = shard
         self.log_path = log_path
         self._log_fh = open(log_path, "ab")
@@ -611,6 +613,7 @@ class EdgeProc:
                 sys.executable, "-m", "byzantine_aircomp_tpu", "edge",
                 "--config", topo, "--shard", str(shard),
                 "--root-url", root_url, "--obs-dir", obs_dir,
+                *(extra or []),
             ],
             stdout=self._log_fh,
             stderr=subprocess.STDOUT,
@@ -780,6 +783,74 @@ def scenario_edge_kill(workdir: str) -> None:
         got = shardctx.decode_leaf(results["rounds"]["0"]["results"][name])
         assert got.tobytes() == ref[0][name].tobytes(), name
     print("edge_kill: OK (degraded survival + bit-identical no-kill run)")
+
+
+def scenario_trace_smoke(workdir: str) -> None:
+    """A healthy 4-edge topology under ``--trace on``: every stream
+    (root + 4 edges) must join into ONE trace with zero orphan spans,
+    every per-round timeline must attribute >=90% of its wall-clock,
+    the Perfetto export must be valid trace-event JSON — and tracing
+    must not cost a lowering (the edges' retrace audit still passes)."""
+    from ..serve.edge import TopologyConfig
+    from . import trace_view as tv
+
+    topo = _topology(workdir)
+    cfg = TopologyConfig.load(topo)
+    obs = os.path.join(workdir, "obs")
+    root = Root(topo, obs, os.path.join(workdir, "root.log"),
+                extra=["--trace", "on"])
+    edges = [
+        EdgeProc(topo, e, root.url, obs,
+                 os.path.join(workdir, f"edge{e}.log"),
+                 extra=["--trace", "on"])
+        for e in range(cfg.edges)
+    ]
+    try:
+        results = root.wait_exit()
+        for e in edges:
+            s = e.summary()
+            assert s["status"] == "completed", s
+            assert s["steady_state_ok"], (
+                f"edge {s['edge']}: tracing cost a lowering: {s}"
+            )
+    finally:
+        for e in edges:
+            e.close()
+        root.close()
+    assert not results["quarantined"], results
+
+    events = tv.load_streams(tv.find_streams(obs), root=obs)
+    traces = tv.assemble(events)
+    assert len(traces) == 1, (
+        f"expected one topology-wide trace, got {sorted(traces)}"
+    )
+    trace = next(iter(traces.values()))
+    assert not trace["orphans"], trace["orphans"]
+    assert len(trace["streams"]) == cfg.edges + 1, trace["streams"]
+    rounds = tv.round_table(trace["spans"])
+    assert len(rounds) == cfg.rounds, rounds
+    for row in rounds:
+        assert row["coverage"] >= 0.90, (
+            f"round {row['round']} attributes only "
+            f"{row['coverage']:.0%} of wall-clock"
+        )
+
+    report_md = os.path.join(workdir, "trace_report.md")
+    report_json = os.path.join(workdir, "trace.json")
+    rc = tv.main([obs, "--out", report_md, "--trace-out", report_json,
+                  "--assert-no-orphans"])
+    assert rc == 0, f"trace_view exited {rc}"
+    with open(report_json) as f:
+        perfetto = json.load(f)
+    spans = [e for e in perfetto["traceEvents"] if e.get("ph") == "X"]
+    assert spans and all(
+        "ts" in e and "dur" in e and "pid" in e for e in spans
+    ), "malformed Perfetto events"
+    print(
+        f"trace_smoke: OK (1 trace, {len(trace['spans'])} spans over "
+        f"{len(trace['streams'])} streams, 0 orphans, min coverage "
+        f"{min(r['coverage'] for r in rounds):.0%})"
+    )
 
 
 def scenario_edge_replay(workdir: str) -> None:
@@ -973,6 +1044,7 @@ SCENARIOS = {
     "kill9": scenario_kill9,
     "edge_kill": scenario_edge_kill,
     "edge_replay": scenario_edge_replay,
+    "trace_smoke": scenario_trace_smoke,
     "edge_ledger": scenario_edge_ledger,
     "torn_tail": scenario_torn_tail,
     "kill_midckpt": scenario_kill_midckpt,

@@ -116,6 +116,28 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
             if comp + steady else None,
         }
 
+    # traced streams (--trace on): stage self-time + per-round critical
+    # path via the cross-process assembler (analysis/trace_view.py)
+    tspans = [
+        e for e in events
+        if e.get("kind") == "span"
+        and e.get("trace_id") is not None
+        and e.get("span_id") is not None
+    ]
+    if tspans:
+        from .trace_view import round_table, stage_table
+
+        out["trace"] = {
+            "trace_ids": sorted({e["trace_id"] for e in tspans}),
+            "stages": stage_table(tspans),
+            "rounds": [
+                {k: r[k] for k in
+                 ("round", "spans", "wall_ms", "coverage",
+                  "top_stage", "top_ms")}
+                for r in round_table(tspans)
+            ],
+        }
+
     retraces = [e for e in events if e.get("kind") == "retrace"]
     if retraces:
         r = retraces[-1]
@@ -235,6 +257,33 @@ def markdown_report(summary: Dict[str, Any]) -> str:
                     f"{cvs['steady_ms']} ms — "
                     f"{cvs['compile_fraction']:.1%} of round time compiling"]
         out.append("")
+
+    tr = summary.get("trace")
+    if tr:
+        out += ["## critical path (traced spans)", "",
+                "trace id(s): "
+                + ", ".join(f"`{t}`" for t in tr["trace_ids"]), "",
+                "| stage | count | total ms | self ms | share |",
+                "|---|---:|---:|---:|---:|"]
+        for row in tr["stages"]:
+            out.append(
+                f"| {row['stage']} | {row['count']} "
+                f"| {row['total_ms']:.1f} | {row['self_ms']:.1f} "
+                f"| {row['share'] * 100:.1f}% |"
+            )
+        if tr["rounds"]:
+            out += ["", "| round | wall ms | attributed | top stage |",
+                    "|---:|---:|---:|---|"]
+            for r in tr["rounds"]:
+                out.append(
+                    f"| {r['round']} | {r['wall_ms']:.1f} "
+                    f"| {r['coverage'] * 100:.1f}% "
+                    f"| {r['top_stage']} ({r['top_ms']:.1f} ms) |"
+                )
+        out += ["",
+                "full cross-process assembly (orphan check, Perfetto "
+                "export): `python -m byzantine_aircomp_tpu.analysis."
+                "trace_view <obs_root>`", ""]
 
     rt = summary.get("retrace")
     if rt:

@@ -60,12 +60,30 @@ TERMINAL = ("completed", "failed", "cancelled")
 SOAK_RULES: List[Dict[str, Any]] = [
     {"name": "soak_admission_p99", "metric": "aircomp_soak_admission_p99_ms",
      "reduce": "last", "op": "gt", "value": None, "severity": "page"},
+    # the server's own aircomp_http_request_seconds histogram, folded to
+    # a gauge each tick — a slow server fires this even when the client
+    # clock would excuse it (and vice versa); bucket-resolution p99
+    {"name": "soak_server_admission_p99",
+     "metric": "aircomp_soak_server_admission_p99_ms",
+     "reduce": "last", "op": "gt", "value": None, "severity": "page"},
     {"name": "soak_scrape_p99", "metric": "aircomp_soak_scrape_p99_ms",
      "reduce": "last", "op": "gt", "value": None, "severity": "page"},
     {"name": "soak_429_misfires", "metric": "aircomp_soak_429_misfires_total",
      "reduce": "last", "op": "ge", "value": 1, "severity": "page",
      "absent": 0.0},
 ]
+
+
+def _bucket_ceiling_ms(threshold_ms: float) -> float:
+    """The smallest HTTP-histogram bucket edge (ms) at or above the
+    client-side threshold — the fair server-side equivalent of a
+    client SLO, given the histogram only resolves to bucket edges."""
+    from ..obs.metrics import HTTP_SECONDS_BUCKETS
+
+    for edge in HTTP_SECONDS_BUCKETS:
+        if edge * 1e3 >= threshold_ms:
+            return edge * 1e3
+    return threshold_ms
 
 
 def _percentile(samples: List[float], q: float) -> Optional[float]:
@@ -137,6 +155,11 @@ def build_rules(args) -> list:
         spec = dict(spec)
         if spec["name"] == "soak_admission_p99":
             spec["value"] = float(args.slo_admission_ms)
+        elif spec["name"] == "soak_server_admission_p99":
+            # bucket-resolution quantile rounds UP to a bucket edge, so
+            # the server-side gate gets the next edge above the client
+            # SLO as headroom rather than a copy of the raw threshold
+            spec["value"] = _bucket_ceiling_ms(float(args.slo_admission_ms))
         elif spec["name"] == "soak_scrape_p99":
             spec["value"] = float(args.slo_scrape_ms)
         soak.append(spec)
@@ -213,6 +236,16 @@ def run_soak(args, log=print) -> Dict[str, Any]:
         if p99s is not None:
             reg.set("aircomp_soak_scrape_p99_ms", p99s,
                     help_text="client-measured /metrics p99 latency")
+        sp99 = reg.quantile(
+            "aircomp_http_request_seconds", 0.99, route="POST /runs"
+        )
+        if sp99 is not None:
+            # +Inf bucket -> clamp to a loud finite sentinel (keeps the
+            # gauge text and the JSON report strictly parseable)
+            reg.set("aircomp_soak_server_admission_p99_ms",
+                    min(sp99 * 1e3, 1e9),
+                    help_text="server-measured POST /runs p99 latency "
+                              "(bucket resolution)")
         reg.set("aircomp_soak_429_misfires_total", float(len(misfires)),
                 help_text="429 responses that were not genuine "
                           "queue-cap rejections")
@@ -346,12 +379,25 @@ def run_soak(args, log=print) -> Dict[str, Any]:
         if occupancy_samples else None
     )
 
+    sp99 = srv.registry.quantile(
+        "aircomp_http_request_seconds", 0.99, route="POST /runs"
+    )
+    server_p99_ms = None if sp99 is None else min(sp99 * 1e3, 1e9)
+    server_slo_ms = _bucket_ceiling_ms(float(args.slo_admission_ms))
+
     slos = [
         {"name": "admission_p99_ms",
          "value": _percentile(lat["admission"], 99),
          "threshold": args.slo_admission_ms,
          "ok": (_percentile(lat["admission"], 99) or 0.0)
          <= args.slo_admission_ms},
+        # the same SLO measured from the other side of the socket: the
+        # server's own route histogram must agree with the client clock
+        {"name": "server_admission_p99_ms",
+         "value": server_p99_ms,
+         "threshold": server_slo_ms,
+         "ok": server_p99_ms is not None
+         and server_p99_ms <= server_slo_ms},
         {"name": "scrape_p99_ms",
          "value": _percentile(lat["scrape"], 99),
          "threshold": args.slo_scrape_ms,

@@ -48,7 +48,7 @@ class Renderer:
     buffers them and flushes one line when the round event lands.
     """
 
-    def __init__(self, out=None) -> None:
+    def __init__(self, out=None, slow_factor: float = 4.0) -> None:
         self.out = out or sys.stdout
         self.k: Optional[int] = None
         self.rung: Optional[int] = None
@@ -59,6 +59,17 @@ class Renderer:
         self.firing: Dict[str, str] = {}  # rule -> severity
         self.rollbacks = 0
         self.lines = 0
+        # --trace on streams: per-round span durations.  Arrival order
+        # differs by path (batched lanes emit the round span BEFORE the
+        # round event, the resident harness after), so durations attach
+        # to the round line when already known and otherwise flag late —
+        # a slow round is loud either way.
+        self.slow_factor = slow_factor
+        self.span_ms: Dict[int, float] = {}
+        self.writer_ms: Dict[int, float] = {}
+        self.printed_rounds: set = set()
+        self.slow_rounds: set = set()
+        self._span_history: List[float] = []
 
     def _print(self, line: str) -> None:
         self.out.write(line + "\n")
@@ -93,6 +104,35 @@ class Renderer:
         if e.get("flagged") and e.get("client") is not None:
             self.flagged_ids.append(int(e["client"]))
 
+    def _on_span(self, e: Dict) -> None:
+        name = e.get("name")
+        rnd = e.get("round")
+        if not isinstance(rnd, int):
+            return
+        ms = float(e.get("ms", 0.0) or 0.0)
+        if name == "round":
+            self.span_ms[rnd] = ms
+            if self._slow(ms):
+                self.slow_rounds.add(rnd)
+                if rnd in self.printed_rounds:
+                    # resident path: the span lands after its round line
+                    # already printed — still make the outlier loud
+                    self._print(
+                        f"!! SLOW round {rnd}: {_num(ms)} ms "
+                        f"(> {_num(self.slow_factor)}x running median)"
+                    )
+        elif name == "writer_task":
+            self.writer_ms[rnd] = self.writer_ms.get(rnd, 0.0) + ms
+
+    def _slow(self, ms: float) -> bool:
+        """Is this round span an outlier vs the running median?"""
+        hist = sorted(self._span_history)
+        self._span_history.append(ms)
+        if len(hist) < 3:
+            return False
+        median = hist[len(hist) // 2]
+        return median > 0 and ms > self.slow_factor * median
+
     def _on_round(self, e: Dict) -> None:
         r = e.get("round", "?")
         parts = [f"r {r:>5}"]
@@ -121,6 +161,16 @@ class Renderer:
                     f"{rule}[{sev}]" for rule, sev in sorted(self.firing.items())
                 )
             )
+        if isinstance(r, int):
+            if r in self.span_ms:
+                parts.append(f"span {_num(self.span_ms[r])}ms")
+            if r in self.writer_ms:
+                parts.append(f"wr {_num(self.writer_ms[r])}ms")
+            if r in self.slow_rounds:
+                parts.append(
+                    f"!! SLOW (> {_num(self.slow_factor)}x median)"
+                )
+            self.printed_rounds.add(r)
         self._print(" | ".join(parts))
         # per-round context consumed; sticky state (rung, alerts) remains
         self.flagged_ids = []
@@ -295,6 +345,10 @@ def main(argv=None) -> int:
                     help="tail one tenant of an experiment-server obs "
                          "root: narrows target to <target>/<run_id>/ "
                          "(the run's private subtree; docs/SERVING.md)")
+    ap.add_argument("--slow-factor", type=float, default=4.0,
+                    help="flag a round whose traced span exceeds this "
+                         "multiple of the running median (--trace on "
+                         "streams only)")
     args = ap.parse_args(argv)
     if args.run is not None:
         if not os.path.isdir(args.target):
@@ -302,7 +356,7 @@ def main(argv=None) -> int:
                   f"{args.target}", file=sys.stderr)
             return 1
         args.target = os.path.join(args.target, args.run)
-    renderer = Renderer()
+    renderer = Renderer(slow_factor=args.slow_factor)
     if args.once:
         stream = discover_stream(args.target)
         if stream is None:

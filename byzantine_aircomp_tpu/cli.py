@@ -308,6 +308,7 @@ ARG_TO_FIELD = {
     "metrics_port": ("metrics_port", None),
     "alerts": ("alerts", None),
     "obs_rotate_mb": ("obs_rotate_mb", None),
+    "trace": ("trace", None),
     "model_parallel": ("model_parallel", None),
     "rounds": ("rounds", None),
     "interval": ("display_interval", None),
@@ -514,6 +515,14 @@ def build_parser() -> argparse.ArgumentParser:
         default=0.0,
         help="rotate the --obs-dir event stream once the live file "
         "passes this many MiB (segments keep one seq envelope; 0 = off)",
+    )
+    p.add_argument(
+        "--trace",
+        choices=["off", "on"],
+        default="off",
+        help="distributed tracing: spans mint trace/span ids, nest, and "
+        "propagate across serving hops via traceparent headers; assemble "
+        "with analysis/trace_view.py (output-only — off is bit-identical)",
     )
     p.add_argument(
         "--quiet",

@@ -446,6 +446,13 @@ class FedConfig:
     # rotate the --obs-dir event stream once the live file passes this
     # many MiB (0 = one unbounded file); segments keep one seq envelope
     obs_rotate_mb: float = 0.0
+    # distributed tracing (obs/trace.py, obs/span.py) — output-only like
+    # every obs knob: excluded from config_hash, never in run_title,
+    # record/RNG/event streams bit-identical off vs on (modulo the ids).
+    # "on" makes spans mint trace/span ids, nest via the context-local
+    # parent stack, and ride traceparent headers across the serving hops
+    # so analysis/trace_view.py can assemble cross-process timelines
+    trace: str = "off"
 
     @property
     def node_size(self) -> int:
@@ -1069,6 +1076,10 @@ class FedConfig:
             raise ValueError(
                 f"async_writer must be auto, on, or off, "
                 f"got {self.async_writer!r}"
+            )
+        if self.trace not in ("off", "on"):
+            raise ValueError(
+                f"trace must be off or on, got {self.trace!r}"
             )
         if self.dispatch_prefetch not in ("off", "on"):
             raise ValueError(

@@ -240,6 +240,10 @@ def config_hash(cfg: FedConfig) -> str:
         # compute — both leave the trajectory and every record payload
         # bit-identical, so they are output-only knobs like the obs trio
         "async_writer", "dispatch_prefetch",
+        # distributed tracing mints ids onto emitted events and headers —
+        # pure output metadata, skipped UNCONDITIONALLY so a traced and
+        # an untraced run share checkpoints and batch-lane signatures
+        "trace",
     )
     if cfg.defense == "off":
         # a defense-off config must hash identically to builds that
@@ -610,6 +614,20 @@ def run(
             f"Serving /metrics and /healthz on port {obs.exporter.port}"
         )
     try:
+        if obs.traced:
+            # the trace root: every harness span (setup/round/eval/
+            # checkpoint) nests under this one "run" span, and when an
+            # ambient context is already active (the server's solo lane
+            # activates the tenant's trace before delegating here) the
+            # run adopts that trace_id — HTTP submit and training stream
+            # share one trace
+            with obs.span("run", title=ckpt_title(cfg)):
+                return _run_inner(
+                    cfg, record_in_file, obs,
+                    persist_paths=persist_paths,
+                    on_checkpoint=on_checkpoint,
+                    writer=writer,
+                )
         return _run_inner(
             cfg, record_in_file, obs,
             persist_paths=persist_paths, on_checkpoint=on_checkpoint,
@@ -692,7 +710,11 @@ def _run_inner(
                 if on_checkpoint is not None:
                     on_checkpoint(r)
 
-            writer.submit(_save_task)
+            # traced runs attribute the off-thread save to the round span
+            # that submitted it (a writer_task span; no-op when untraced)
+            writer.submit_traced(
+                _save_task, "checkpoint", sink=obs.sink, round=r
+            )
 
         if cfg.inherit:
             # a torn npz (killed mid-write before the atomic rename ever
@@ -974,7 +996,11 @@ def _run_inner(
             # checkpoint), then drains so the record is durable before
             # run() returns — callers (chaos harness, the server's solo
             # lane) read the file immediately
-            writer.submit(lambda: io_lib.atomic_pickle(path, record))
+            writer.submit_traced(
+                lambda: io_lib.atomic_pickle(path, record),
+                "record_pickle",
+                sink=obs.sink,
+            )
             writer.drain()
         else:
             io_lib.atomic_pickle(path, record)

@@ -63,6 +63,7 @@ from .sinks import (  # noqa: F401
     StdoutSink,
 )
 from .span import SpanTimer
+from . import trace  # noqa: F401
 from .writer import AsyncSink, WriterThread, resolve_async  # noqa: F401
 
 
@@ -93,12 +94,57 @@ class Observability:
         self.metrics_sink = metrics_sink
         self.alert_engine = alert_engine
         self.exporter = None
+        # (trace_id, root_span_id) under --trace on for serve-managed
+        # runs: the identity every retrospective span_event hangs off
+        self.trace_root = None
+
+    @property
+    def traced(self) -> bool:
+        return self._spans.traced
+
+    @traced.setter
+    def traced(self, value: bool) -> None:
+        self._spans.traced = bool(value)
 
     def emit(self, kind: str, **fields) -> None:
         self.sink.emit(make_event(kind, **fields))
 
     def span(self, name: str, sync=None, **fields):
         return self._spans.span(name, sync=sync, **fields)
+
+    def span_event(self, name: str, ms: float, **fields) -> None:
+        """Emit a retrospectively-timed span (measured outside a context
+        manager — e.g. queue wait, a lane's slice of a vmapped round).
+
+        No-op unless this façade is traced: these spans exist only for
+        the trace layer, so ``--trace off`` streams stay bit-identical
+        to pre-trace builds.  ``trace_id``/``span_id``/``parent_span_id``
+        in ``fields`` win; otherwise ids come from :attr:`trace_root`
+        (span_id always minted fresh, parent defaulting to the root
+        span so per-run streams assemble into one tree).
+        """
+        if not self._spans.traced:
+            return
+        if "trace_id" not in fields:
+            if self.trace_root is not None:
+                fields["trace_id"] = self.trace_root[0]
+            else:
+                ctx = trace.current()
+                fields["trace_id"] = (
+                    ctx[0] if ctx is not None else trace.new_trace_id()
+                )
+        fields.setdefault("span_id", trace.new_span_id())
+        if (
+            "parent_span_id" not in fields
+            and self.trace_root is not None
+            and self.trace_root[0] == fields["trace_id"]
+            and self.trace_root[1] is not None
+            and fields["span_id"] != self.trace_root[1]
+        ):
+            fields["parent_span_id"] = self.trace_root[1]
+        self.sink.emit(
+            make_event("span", name=name, ms=round(float(ms), 3), **fields)
+        )
 
     def round(self, round_idx: int, **metrics) -> None:
         self.collector.round_event(round_idx, **metrics)
@@ -165,9 +211,13 @@ def from_config(
             alert_engine = AlertEngine(load_rules(cfg.alerts), registry)
     if not sinks:
         return NULL
-    return Observability(
+    out = Observability(
         sinks[0] if len(sinks) == 1 else MultiSink(sinks),
         registry=registry,
         metrics_sink=metrics_sink,
         alert_engine=alert_engine,
     )
+    # output-only: flips span emission into id-minting mode, never the
+    # training program (config_hash skips it alongside the other obs knobs)
+    out.traced = getattr(cfg, "trace", "off") == "on"
+    return out

@@ -54,6 +54,7 @@ OPS = {
 _RULE_KEYS = {
     "name", "metric", "labels", "window", "reduce", "op", "value",
     "value_metric", "value_scale", "severity", "min_samples", "absent",
+    "quantile",
 }
 
 
@@ -73,8 +74,18 @@ class Rule:
     severity: str = "warn"
     min_samples: int = 1
     absent: Optional[float] = None
+    # when set (0 < q < 1), the metric must be a histogram and each
+    # round's sample is its bucket-resolution q-quantile instead of the
+    # scalar/count ``value`` returns — the shape span-derived latency
+    # SLOs need (queue-wait p99, round critical-path ceiling)
+    quantile: Optional[float] = None
 
     def __post_init__(self) -> None:
+        if self.quantile is not None and not (0.0 < self.quantile < 1.0):
+            raise ValueError(
+                f"rule {self.name!r}: quantile must be in (0, 1), "
+                f"got {self.quantile!r}"
+            )
         if self.severity not in SEVERITIES:
             raise ValueError(
                 f"rule {self.name!r}: severity must be one of {SEVERITIES}, "
@@ -173,6 +184,21 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
     {"name": "lane_occupancy_floor", "metric": "aircomp_lane_occupancy",
      "window": 4, "reduce": "max", "op": "lt", "value": 0.9,
      "severity": "warn", "min_samples": 4},
+    # span-derived latency SLOs (PR 20).  Both sample histograms the
+    # MetricsSink folds from span events at bucket-resolution quantiles;
+    # no ``absent`` stand-in, so runs that never emit the span (no
+    # admission queue / no round spans folded yet) stay silent.
+    # admission wait: a tenant queued more than 30s at p99 means the
+    # scheduler is starved or the group is wedged behind a slow lane
+    {"name": "queue_wait_p99", "metric": "aircomp_queue_wait_seconds",
+     "reduce": "last", "op": "gt", "value": 30.0, "quantile": 0.99,
+     "severity": "warn"},
+    # round critical-path ceiling: the server-measured round span (the
+    # whole dispatch critical path, not just device time) above 60s at
+    # p99 — loose on purpose; tune per-deployment
+    {"name": "round_critical_path", "metric": "aircomp_stage_seconds",
+     "labels": {"stage": "round"}, "reduce": "last", "op": "gt",
+     "value": 60.0, "quantile": 0.99, "severity": "warn"},
 ]
 
 
@@ -219,7 +245,12 @@ class AlertEngine:
         emitted: List[Dict[str, Any]] = []
         for rule in self.rules:
             st = self._state[rule.name]
-            sample = self.registry.value(rule.metric, **rule.labels)
+            if rule.quantile is not None:
+                sample = self.registry.quantile(
+                    rule.metric, rule.quantile, **rule.labels
+                )
+            else:
+                sample = self.registry.value(rule.metric, **rule.labels)
             if sample is None:
                 if rule.absent is None:
                     continue  # metric not born yet and no stand-in
@@ -405,6 +436,26 @@ def _scenarios() -> Dict[str, Dict[str, List[Dict[str, Any]]]]:
                 _mk("client_flag", round=2, client=3, score=4.0, rung=0,
                     flagged=True),
             ] + rounds(2, start=2),
+        },
+        "queue_wait_p99": {
+            # a tenant seated in 50ms: p99 resolves to a sub-second
+            # bucket edge, far under the 30s ceiling
+            "healthy": start + [
+                _mk("span", name="queue_wait", ms=50.0, run_id="r1"),
+            ] + rounds(4),
+            # 90s in the admission queue lands in the +Inf bucket; the
+            # quantile saturates and the ceiling fires
+            "breach": start + [
+                _mk("span", name="queue_wait", ms=90_000.0, run_id="r1"),
+            ] + rounds(4),
+        },
+        "round_critical_path": {
+            "healthy": start + [
+                _mk("span", name="round", ms=20.0, round=0),
+            ] + rounds(4),
+            "breach": start + [
+                _mk("span", name="round", ms=120_000.0, round=0),
+            ] + rounds(4),
         },
         "lane_occupancy_floor": {
             # a single-round sag (one lane draining before its refill

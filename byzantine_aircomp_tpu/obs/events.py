@@ -45,6 +45,8 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Optional
 
+from . import trace
+
 # v2: added the sink-stamped ``seq`` envelope key and the forensics kinds
 # ``client_flag`` / ``forensic_dump`` (obs/forensics.py).
 # v3: added the live-telemetry kinds ``alert`` (obs/alerts.py SLO rule
@@ -93,7 +95,17 @@ from typing import Any, Dict, Optional
 # drained lane's slot reseated from the admission queue mid-group: which
 # lane, the incoming tenant's own resume round, and the group round the
 # splice landed at — the journal's ``refill`` op is the durable twin).
-SCHEMA_VERSION = 9
+# v10: added the optional trace-context envelope keys ``trace_id`` (32-hex,
+# shared by every event one logical request touches, across processes),
+# ``span_id`` (16-hex — on a ``span`` event the span's own id, on any
+# other event the enclosing span at emission), and ``parent_span_id``
+# (the span this one nests under; absent on trace roots).  Stamped by
+# ``make_event`` only while an ``obs.trace`` context is active — with
+# ``--trace off`` (the default) nothing activates the context, so
+# streams stay byte-identical to v9 modulo this version bump.  No kind's
+# required fields changed, so the fingerprint matches v9's (the v5
+# precedent: envelope-only additions).
+SCHEMA_VERSION = 10
 
 # round-event field -> reference pickled-record key it mirrors
 # (round r's event carries metrics the record stores at index r+1 for the
@@ -205,13 +217,24 @@ def _host_id() -> int:
 
 
 def make_event(kind: str, **fields: Any) -> Dict[str, Any]:
-    """Stamp ``fields`` into a schema-versioned event dict."""
+    """Stamp ``fields`` into a schema-versioned event dict.
+
+    While a trace context is active (``obs.trace`` — only ever under
+    ``--trace on``) the envelope additionally carries ``trace_id`` and,
+    when the context names an enclosing span, ``span_id``.  Explicit
+    ``fields`` win — a span event's own ids are never overwritten.
+    """
     event: Dict[str, Any] = {
         "v": SCHEMA_VERSION,
         "kind": kind,
         "ts": time.time(),
         "host_id": _host_id(),
     }
+    ctx = trace.current()
+    if ctx is not None:
+        event["trace_id"] = ctx[0]
+        if ctx[1] is not None:
+            event["span_id"] = ctx[1]
     event.update(fields)
     return event
 

@@ -44,6 +44,13 @@ ROUND_SECONDS_BUCKETS = (
     0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
 
+#: fixed bucket upper bounds for HTTP request-latency histograms
+#: (seconds) — server handling is sub-second in the common case, so the
+#: grid starts finer than the round grid
+HTTP_SECONDS_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+
 #: run-phase gauge values (aircomp_run_phase)
 PHASE_STARTING, PHASE_RUNNING, PHASE_DONE = 0, 1, 2
 
@@ -146,6 +153,33 @@ class MetricsRegistry:
                 return None
             return float(v[2]) if fam.kind == "histogram" else float(v)
 
+    def quantile(self, name: str, q: float,
+                 **labels: str) -> Optional[float]:
+        """Bucket-resolution quantile of a histogram series: the
+        smallest bucket upper bound whose cumulative count reaches the
+        nearest-rank position — a conservative (upper-bound) estimate,
+        which is the right bias for an SLO ceiling.  ``math.inf`` when
+        the rank lands in the implicit +Inf bucket; None when the
+        family/series is absent or empty (the alert engine skips, same
+        as ``value``)."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None or fam.kind != "histogram":
+                return None
+            v = fam.series.get(_labelkey(labels))
+            if v is None:
+                return None
+            counts, _total, n = v
+            if n <= 0:
+                return None
+            rank = max(1, math.ceil(float(q) * n))
+            cum = 0
+            for edge, c in zip(fam.buckets, counts):
+                cum += c
+                if cum >= rank:
+                    return float(edge)
+            return math.inf
+
     def snapshot(self) -> Dict[str, Any]:
         """Point-in-time copy of every series, taken under the lock so a
         histogram's bucket counts always sum to its count."""
@@ -228,6 +262,10 @@ class LabeledRegistry:
     def value(self, name: str, **labels: str) -> Optional[float]:
         return self.base.value(name, **{**self.labels, **labels})
 
+    def quantile(self, name: str, q: float,
+                 **labels: str) -> Optional[float]:
+        return self.base.quantile(name, q, **{**self.labels, **labels})
+
     def snapshot(self) -> Dict[str, Any]:
         return self.base.snapshot()
 
@@ -304,6 +342,27 @@ class MetricsSink(EventSink):
         if e.get("rounds") is not None:
             reg.set("aircomp_rounds_scheduled", e["rounds"],
                     help_text="scheduled round horizon")
+
+    def _on_span(self, e: Dict[str, Any]) -> None:
+        # stage-latency histograms: every span folds into
+        # aircomp_stage_seconds{stage=<name>} (span names are a small
+        # closed set — setup/round/dispatch/eval/checkpoint/run/
+        # queue_wait/writer_task/... — so cardinality stays bounded even
+        # before the MAX_SERIES fold), and queue_wait additionally feeds
+        # the dedicated admission-wait histogram the SLO rule samples
+        reg = self.registry
+        ms = e.get("ms")
+        if ms is None or not _finite(ms):
+            return
+        secs = float(ms) / 1e3
+        stage = str(e.get("name", "unknown"))
+        reg.observe("aircomp_stage_seconds", secs,
+                    help_text="span-derived stage latency, by span name",
+                    stage=stage)
+        if stage == "queue_wait":
+            reg.observe("aircomp_queue_wait_seconds", secs,
+                        help_text="admission queue wait "
+                        "(run_submitted to lane seat)")
 
     def _on_round(self, e: Dict[str, Any]) -> None:
         reg = self.registry

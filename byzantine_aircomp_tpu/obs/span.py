@@ -11,6 +11,15 @@ cost two clock reads.
 The context manager yields a mutable dict: fields set on it inside the
 body land on the emitted event (e.g. the round span's ``compiled`` flag,
 known only after the body has run).
+
+With ``traced`` set (the ``--trace on`` knob), every span additionally
+mints a 16-hex ``span_id``, inherits ``trace_id`` from the ambient
+context (minting a fresh trace when it is the first span), records the
+enclosing span as ``parent_span_id``, and pushes itself onto the
+context-local parent stack for the body's duration — so spans nest and
+any event emitted inside the body is stamped with the enclosing span
+(see ``obs/trace.py``).  Untraced (the default), none of that runs and
+the emitted event is byte-identical to the historical shape.
 """
 
 from __future__ import annotations
@@ -19,18 +28,38 @@ import contextlib
 import time
 from typing import Any, Dict, Iterator, Optional
 
+from . import trace as trace_lib
 from .events import make_event
 
 
 class SpanTimer:
     def __init__(self, sink) -> None:
         self._sink = sink
+        # flipped by Observability.from_config under --trace on; an
+        # output-only knob, so it never forks config_hash or records
+        self.traced = False
 
     @contextlib.contextmanager
     def span(
         self, name: str, sync: Optional[Any] = None, **fields: Any
     ) -> Iterator[Dict[str, Any]]:
         extra: Dict[str, Any] = dict(fields)
+        token = None
+        if self.traced:
+            ctx = trace_lib.current()
+            if "trace_id" not in extra:
+                extra["trace_id"] = (
+                    ctx[0] if ctx is not None else trace_lib.new_trace_id()
+                )
+            if (
+                "parent_span_id" not in extra
+                and ctx is not None
+                and ctx[1] is not None
+                and ctx[0] == extra["trace_id"]
+            ):
+                extra["parent_span_id"] = ctx[1]
+            extra["span_id"] = trace_lib.new_span_id()
+            token = trace_lib.push(extra["trace_id"], extra["span_id"])
         t0 = time.perf_counter()
         try:
             yield extra
@@ -43,7 +72,13 @@ class SpanTimer:
             # the tail of a crashed run is exactly when timing data matters
             extra.setdefault("error", True)
             ms = (time.perf_counter() - t0) * 1e3
+            if token is not None:
+                trace_lib.pop(token)
+                token = None
             self._sink.emit(make_event("span", name=name, ms=round(ms, 3), **extra))
             raise
+        finally:
+            if token is not None:
+                trace_lib.pop(token)
         ms = (time.perf_counter() - t0) * 1e3
         self._sink.emit(make_event("span", name=name, ms=round(ms, 3), **extra))

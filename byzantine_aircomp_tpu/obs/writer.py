@@ -39,11 +39,28 @@ from __future__ import annotations
 import queue
 import sys
 import threading
+import time
 from typing import Any, Callable, Dict, Optional
 
-from .sinks import EventSink
+from . import trace as trace_lib
+from .sinks import EventSink, MultiSink
 
 _STOP = object()
+
+
+def _sync_sinks(sink):
+    """The synchronous leaves under ``sink`` — AsyncSink unwrapped to its
+    inner, MultiSink fanned out.  Writer-rim span events MUST emit on
+    these directly: the emitting code runs ON the writer thread, and
+    enqueueing from the consumer can deadlock at a full queue."""
+    if isinstance(sink, AsyncSink):
+        return _sync_sinks(sink.inner)
+    if isinstance(sink, MultiSink):
+        out = []
+        for s in sink.sinks:
+            out.extend(_sync_sinks(s))
+        return out
+    return [sink]
 
 
 def resolve_async(cfg) -> bool:
@@ -93,6 +110,63 @@ class WriterThread:
             self._run(fn)
             return
         self._q.put(fn)
+
+    def submit_traced(
+        self,
+        fn: Callable[[], None],
+        task: str,
+        sink: Optional[EventSink] = None,
+        **fields: Any,
+    ) -> None:
+        """``submit``, plus trace attribution of the off-thread work.
+
+        When a trace context is active at SUBMIT time (only ever under
+        ``--trace on``) the task is wrapped to emit a ``writer_task``
+        span after it runs: ``ms`` is the on-thread execution time,
+        ``queued_ms`` the time spent waiting in the rim queue, and
+        ``parent_span_id`` the span that submitted it — so checkpoint
+        serialization and record pickles are attributed to the round
+        that caused them instead of orphaned on the writer thread.  The
+        span emits on ``sink``'s synchronous leaves (never back through
+        the queue — the consumer must not block on itself).  With no
+        active context this is exactly ``submit``.
+        """
+        ctx = trace_lib.current()
+        if ctx is None or sink is None:
+            self.submit(fn)
+            return
+        from .events import make_event  # local: avoid import cycle
+
+        trace_id, parent = ctx
+        leaves = _sync_sinks(sink)
+        t_submit = time.perf_counter()
+
+        def wrapped() -> None:
+            t0 = time.perf_counter()
+            try:
+                fn()
+            finally:
+                t1 = time.perf_counter()
+                extra = dict(fields)
+                extra["trace_id"] = trace_id
+                extra["span_id"] = trace_lib.new_span_id()
+                if parent is not None:
+                    extra["parent_span_id"] = parent
+                ev = make_event(
+                    "span",
+                    name="writer_task",
+                    ms=round((t1 - t0) * 1e3, 3),
+                    task=task,
+                    queued_ms=round((t0 - t_submit) * 1e3, 3),
+                    **extra,
+                )
+                for leaf in leaves:
+                    try:
+                        leaf.emit(ev)
+                    except Exception:  # noqa: BLE001 - span is best-effort
+                        pass
+
+        self.submit(wrapped)
 
     def _run(self, fn: Callable[[], None]) -> None:
         try:

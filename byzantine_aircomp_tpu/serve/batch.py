@@ -121,6 +121,9 @@ _OUTPUT_ONLY = (
     # rounds_per_dispatch itself is NOT here — R>1 runs route solo
     # (RunRegistry._is_solo) and R forks the hash lineage.
     "async_writer", "dispatch_prefetch",
+    # trace is emission-only: it flips span events into id-minting mode,
+    # never the traced program
+    "trace",
 )
 
 
@@ -756,6 +759,15 @@ class BatchRunner:
                         i, r, "non-finite parameters", on_quarantine, log
                     )
                     continue
+                # traced lanes record their slice of the vmapped round
+                # retrospectively (one device program, N tenant spans);
+                # emitted BEFORE the round event so the tail renderer
+                # can attach the duration to the line it annotates
+                lane_obs = self.obs_list[i] or obs_lib.NULL
+                lane_obs.span_event(
+                    "round", ms=dt * 1e3,
+                    round=r, lane=i, compiled=compiled,
+                )
                 try:
                     self._record_lane(
                         i, r, float(var_np[i]),

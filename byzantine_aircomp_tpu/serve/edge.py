@@ -363,16 +363,32 @@ class EdgeClient:
         self._round = -1
         self._epoch = 0
         self._seq = 0
+        # distributed tracing: an outbound W3C header set by the edge
+        # loop per round (never part of the HMAC-signed body — the wire
+        # schema and its signature are trace-agnostic), plus an HTTP-time
+        # accumulator the loop drains into its edge_exchange span
+        self.traceparent: Optional[str] = None
+        self._exchange_ms = 0.0
 
     # --------------------------------------------------------- plumbing
+
+    def take_exchange_ms(self) -> float:
+        """Drain the accumulated on-the-wire time (ms) since last call."""
+        ms, self._exchange_ms = self._exchange_ms, 0.0
+        return ms
 
     def _request(self, method: str, path: str,
                  body: Optional[dict] = None) -> Tuple[int, dict]:
         data = json.dumps(body).encode() if body is not None else None
+        headers: Dict[str, str] = {}
+        if data:
+            headers["Content-Type"] = "application/json"
+        if self.traceparent is not None:
+            headers["traceparent"] = self.traceparent
         req = urllib.request.Request(
-            self.root_url + path, data=data, method=method,
-            headers={"Content-Type": "application/json"} if data else {},
+            self.root_url + path, data=data, method=method, headers=headers,
         )
+        t0 = time.monotonic()
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return resp.status, json.loads(resp.read().decode() or "{}")
@@ -382,6 +398,8 @@ class EdgeClient:
                 return exc.code, json.loads(raw or "{}")
             except json.JSONDecodeError:
                 return exc.code, {"error": raw}
+        finally:
+            self._exchange_ms += (time.monotonic() - t0) * 1e3
 
     def _raise_for(self, status: int, resp: dict) -> None:
         if status == 410:
@@ -473,7 +491,8 @@ def _classify(exc: BaseException) -> Optional[str]:
 
 
 def run_edge(cfg: TopologyConfig, shard: int, root_url: str,
-             obs_dir: Optional[str] = None) -> Dict[str, Any]:
+             obs_dir: Optional[str] = None,
+             trace: bool = False) -> Dict[str, Any]:
     """Run one edge through every round; returns a summary dict.
 
     Exit invariants (the chaos harness asserts them via the return/exit
@@ -497,11 +516,28 @@ def run_edge(cfg: TopologyConfig, shard: int, root_url: str,
     compute = EdgeCompute(cfg, shard, client.exchange)
     status = "completed"
     rounds_run = 0
+    # --trace on: the whole topology shares ONE trace — the root mints
+    # the id and publishes it in round_info, every edge adopts it on
+    # first poll (minting a private one only if the root predates the
+    # field), and each round's submissions carry the edge_round span as
+    # traceparent so the root's ingress events correlate back
+    trace_id: Optional[str] = None
     try:
         for rnd in range(cfg.rounds):
             stack = round_stack(cfg.seed, rnd, cfg.k, cfg.d)
+            round_span = obs_lib.trace.new_span_id() if trace else None
+            t0 = time.perf_counter()
+            client.take_exchange_ms()
             while True:
                 state = client.round_state(rnd)
+                if trace and trace_id is None:
+                    trace_id = (
+                        state.get("trace_id") or obs_lib.trace.new_trace_id()
+                    )
+                if trace:
+                    client.traceparent = obs_lib.trace.format_traceparent(
+                        trace_id, round_span
+                    )
                 live = list(state.get("live", []))
                 if shard not in live:
                     raise EdgeQuarantined("not in live set")
@@ -512,6 +548,21 @@ def run_edge(cfg: TopologyConfig, shard: int, root_url: str,
                     jax.block_until_ready(out)
                     client.done(rnd)
                     rounds_run += 1
+                    if trace:
+                        ms = (time.perf_counter() - t0) * 1e3
+                        ex_ms = client.take_exchange_ms()
+                        sink.emit(obs_lib.make_event(
+                            "span", name="edge_round", ms=round(ms, 3),
+                            round=rnd, edge=shard,
+                            trace_id=trace_id, span_id=round_span,
+                        ))
+                        sink.emit(obs_lib.make_event(
+                            "span", name="edge_exchange",
+                            ms=round(ex_ms, 3),
+                            round=rnd, edge=shard, trace_id=trace_id,
+                            span_id=obs_lib.trace.new_span_id(),
+                            parent_span_id=round_span,
+                        ))
                     break
                 except Exception as exc:  # noqa: BLE001 — see _classify
                     kind = _classify(exc)
@@ -556,6 +607,9 @@ def main(argv=None) -> int:
                    help="root base URL, e.g. http://127.0.0.1:8123")
     p.add_argument("--obs-dir", default=None,
                    help="directory for this edge's event stream")
+    p.add_argument("--trace", choices=("off", "on"), default="off",
+                   help="emit per-round edge spans and propagate the "
+                        "topology trace id on every request (output-only)")
     args = p.parse_args(argv)
     # the ordered io_callback logs a full traceback at ERROR for every
     # protocol exception (epoch restarts are routine, not errors)
@@ -563,7 +617,10 @@ def main(argv=None) -> int:
 
     logging.getLogger("jax._src.callback").setLevel(logging.CRITICAL)
     cfg = TopologyConfig.load(args.config)
-    summary = run_edge(cfg, args.shard, args.root_url, args.obs_dir)
+    summary = run_edge(
+        cfg, args.shard, args.root_url, args.obs_dir,
+        trace=args.trace == "on",
+    )
     print(f"edge {args.shard}: {json.dumps(summary)}", flush=True)
     if not summary["steady_state_ok"]:
         return 2

@@ -97,9 +97,17 @@ class RootState:
         obs_dir: Optional[str] = None,
         registry=None,
         now_fn=time.time,
+        trace: bool = False,
     ) -> None:
         self.cfg = cfg
         self.now = now_fn
+        # --trace on: the root mints the topology-wide trace id (edges
+        # adopt it from round_info) and emits per-round root_round /
+        # root_fold spans on its own stream
+        self.trace = trace
+        self.trace_id: Optional[str] = (
+            obs_lib.trace.new_trace_id() if trace else None
+        )
         self._lock = threading.RLock()
         self.registry = (
             registry if registry is not None else obs_lib.MetricsRegistry()
@@ -262,6 +270,20 @@ class RootState:
     # ------------------------------------------------------------- folds
 
     def _fold(self, key: Tuple[int, int, int], phase: Dict[str, Any]) -> None:
+        if not self.trace:
+            return self._fold_inner(key, phase)
+        t0 = time.perf_counter()
+        try:
+            return self._fold_inner(key, phase)
+        finally:
+            rst = self._round(key[0])
+            rst["fold_ms"] = (
+                rst.get("fold_ms", 0.0) + (time.perf_counter() - t0) * 1e3
+            )
+
+    def _fold_inner(
+        self, key: Tuple[int, int, int], phase: Dict[str, Any]
+    ) -> None:
         order = sorted(phase["subs"])
         tags = phase["tags"]
         subs = phase["subs"]
@@ -320,10 +342,17 @@ class RootState:
     # ---------------------------------------------------------- deadline
 
     def _round(self, rnd: int) -> Dict[str, Any]:
-        return self.rounds.setdefault(rnd, {
+        rst = self.rounds.setdefault(rnd, {
             "ingress": 0, "done": set(), "completed": False,
             "results": {}, "done_first_ts": None, "epoch": self.epoch,
         })
+        if self.trace and "span_id" not in rst:
+            # the round's root_round span opens at first ingress and is
+            # emitted retrospectively when the round completes
+            rst["span_id"] = obs_lib.trace.new_span_id()
+            rst["t0"] = self.now()
+            rst["fold_ms"] = 0.0
+        return rst
 
     def deadline_check(self, now: Optional[float] = None) -> None:
         """Quarantine edges that keep a phase (or a round close) waiting
@@ -353,7 +382,9 @@ class RootState:
 
     # ------------------------------------------------------------ routes
 
-    def submit_partial(self, raw: bytes) -> Tuple[int, Dict[str, Any]]:
+    def submit_partial(
+        self, raw: bytes, traceparent=None
+    ) -> Tuple[int, Dict[str, Any]]:
         with self._lock:
             try:
                 body = json.loads(raw.decode())
@@ -397,9 +428,16 @@ class RootState:
                 }
                 rst = self._round(rnd)
                 rst["ingress"] += len(raw)
+                extra: Dict[str, Any] = {}
+                if self.trace and traceparent is not None:
+                    # the ingress event happened WITHIN the edge's round
+                    # span: correlate via the W3C header (the envelope,
+                    # never the HMAC-signed body)
+                    extra["trace_id"] = traceparent[0]
+                    extra["span_id"] = traceparent[1]
                 self._emit(
                     "edge_partial", round=rnd, edge=edge, seq=seq,
-                    bytes=len(raw),
+                    bytes=len(raw), **extra,
                 )
                 if self.live <= set(phase["subs"]):
                     self._resolve(key, phase, submitter=edge)
@@ -488,6 +526,21 @@ class RootState:
             edges=len(self.live), degraded=degraded,
             ingress_bytes=rst["ingress"],
         )
+        if self.trace:
+            span_id = rst.get("span_id") or obs_lib.trace.new_span_id()
+            ms = max(self.now() - rst.get("t0", self.now()), 0.0) * 1e3
+            self._emit(
+                "span", name="root_round", ms=round(ms, 3),
+                round=rnd, epoch=self.epoch,
+                trace_id=self.trace_id, span_id=span_id,
+            )
+            self._emit(
+                "span", name="root_fold",
+                ms=round(rst.get("fold_ms", 0.0), 3),
+                round=rnd, trace_id=self.trace_id,
+                span_id=obs_lib.trace.new_span_id(),
+                parent_span_id=span_id,
+            )
         for e in sorted(self.live):
             self._journal(
                 "partial", e, round=rnd, nonce=self.nonces[e],
@@ -501,12 +554,17 @@ class RootState:
     def round_info(self, rnd: int) -> Dict[str, Any]:
         with self._lock:
             rst = self.rounds.get(rnd)
-            return {
+            info = {
                 "round": rnd,
                 "epoch": self.epoch,
                 "live": sorted(self.live),
                 "completed": bool(rst and rst["completed"]),
             }
+            if self.trace_id is not None:
+                # edges adopt this on first poll, so the whole topology
+                # shares one trace
+                info["trace_id"] = self.trace_id
+            return info
 
     def results(self) -> Dict[str, Any]:
         with self._lock:
@@ -558,8 +616,9 @@ class RootServer:
         obs_dir: Optional[str] = None,
         port: int = 0,
         host: str = "0.0.0.0",
+        trace: bool = False,
     ) -> None:
-        self.state = RootState(cfg, obs_dir=obs_dir)
+        self.state = RootState(cfg, obs_dir=obs_dir, trace=trace)
         self.exporter = obs_lib.MetricsExporter(
             self.state.registry,
             port=port,
@@ -611,7 +670,14 @@ class RootServer:
         self.state.deadline_check()
         try:
             if parts[0] == "partials" and method == "POST":
-                return self._json(*self.state.submit_partial(body))
+                from ..obs.trace import parse_traceparent
+
+                return self._json(*self.state.submit_partial(
+                    body,
+                    traceparent=parse_traceparent(
+                        (headers or {}).get("traceparent")
+                    ),
+                ))
             if parts[0] == "done" and method == "POST":
                 return self._json(*self.state.submit_done(body))
             if parts[0] == "fold" and len(parts) == 3 and method == "GET":
@@ -647,10 +713,14 @@ def main(argv=None) -> int:
     p.add_argument("--linger", type=float, default=5.0,
                    help="seconds to keep serving after all rounds close "
                         "(lets the harness scrape /results)")
+    p.add_argument("--trace", choices=("off", "on"), default="off",
+                   help="mint a topology trace id and emit per-round "
+                        "root_round/root_fold spans (output-only)")
     args = p.parse_args(argv)
     cfg = TopologyConfig.load(args.config)
     server = RootServer(
-        cfg, obs_dir=args.obs_dir, port=args.port, host=args.host
+        cfg, obs_dir=args.obs_dir, port=args.port, host=args.host,
+        trace=args.trace == "on",
     ).start()
     # parsed by the chaos harness; keep the trailing space (port parse)
     print(f"edge root on {args.host}:{server.port} ", flush=True)

@@ -114,6 +114,16 @@ class Run:
         self.idempotency_key: Optional[str] = None
         self.final: Optional[Dict[str, Any]] = None  # journal-adopted val stats
         self.record_path: Optional[str] = None
+        # distributed tracing (--trace on tenants only): the tenant's
+        # trace id (adopted from the submit's traceparent header when
+        # present, minted otherwise), the pre-minted "run_request" root
+        # span every per-run span hangs off, and the client's span id
+        # (recorded on the root as remote_parent_span_id — kept out of
+        # parent_span_id so local orphan detection stays meaningful)
+        self.submitted_at = time.time()
+        self.trace_id: Optional[str] = None
+        self.root_span_id: Optional[str] = None
+        self.remote_parent: Optional[str] = None
 
     def info(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -142,6 +152,8 @@ class Run:
             d["wedged"] = True
         if self.lowerings is not None:
             d["lowerings"] = self.lowerings
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
         if self.error is not None:
             d["error"] = self.error
         if self.record_path is not None:
@@ -243,12 +255,80 @@ class RunManager:
             # health bar (0 keeps per-sink wedge detection disabled)
             msink.wedge_secs = self.wedge_secs
             sink = obs_lib.MultiSink([sink, msink])
-        return obs_lib.Observability(sink)
+        out = obs_lib.Observability(sink)
+        out.traced = getattr(cfg, "trace", "off") == "on"
+        return out
+
+    # ----------------------------------------------------------- tracing
+
+    @staticmethod
+    def _init_trace(run: Run, traceparent=None) -> None:
+        """Mint (or adopt, from a submit's traceparent header) the
+        tenant's trace identity and hang it on the run's obs façade so
+        every retrospective span (queue_wait, lane_install, per-lane
+        rounds, the run_request root) shares one tree.  No-op for
+        untraced tenants."""
+        if not run.obs.traced:
+            return
+        if traceparent is not None:
+            run.trace_id = traceparent[0]
+            run.remote_parent = traceparent[1]
+        else:
+            run.trace_id = obs_lib.trace.new_trace_id()
+        run.root_span_id = obs_lib.trace.new_span_id()
+        run.obs.trace_root = (run.trace_id, run.root_span_id)
+
+    @staticmethod
+    def _trace_fields(run: Run) -> Dict[str, Any]:
+        """Envelope correlation for a tenant's control-plane events:
+        the run's trace id plus the root span as the enclosing span.
+        Empty for untraced tenants, so their streams stay byte-identical
+        to pre-trace builds."""
+        if run.trace_id is None:
+            return {}
+        out: Dict[str, Any] = {"trace_id": run.trace_id}
+        if run.root_span_id is not None:
+            out["span_id"] = run.root_span_id
+        return out
+
+    def _reopen_obs(self, run: Run) -> None:
+        """Reopen a handed-over stream (solo finalization) with the
+        run's trace identity restored."""
+        run.obs = self._open_obs(run.run_id, run.cfg, run.title)
+        if run.trace_id is not None and run.obs.traced:
+            run.obs.trace_root = (run.trace_id, run.root_span_id)
+
+    def _close_run_obs(self, run: Run) -> None:
+        """Every terminal transition funnels here: emit the tenant's
+        ``run_request`` root span (traced runs only — submit to terminal
+        wall-clock, the id every other per-run span parents to) and
+        close the stream, so no trace leaves its root unclosed."""
+        if (
+            run.trace_id is not None
+            and run.obs is not obs_lib.NULL
+            and run.obs.traced
+        ):
+            extra: Dict[str, Any] = {}
+            if run.remote_parent is not None:
+                extra["remote_parent_span_id"] = run.remote_parent
+            run.obs.span_event(
+                "run_request",
+                ms=(time.time() - run.submitted_at) * 1e3,
+                run_id=run.run_id,
+                span_id=run.root_span_id,
+                status=run.status,
+                **extra,
+            )
+        run.obs.close()
+        # detach so a second terminal sweep (e.g. a group-level _fail
+        # after a lane already finalized) can never re-emit the root
+        run.obs = obs_lib.NULL
 
     def submit(
         self,
         cfg: FedConfig,
         idempotency_key: Optional[str] = None,
+        traceparent: Optional[Tuple[str, str]] = None,
     ) -> str:
         """Register + queue one run; returns its server-assigned id.
 
@@ -291,9 +371,11 @@ class RunManager:
                 idempotency_key=idempotency_key,
             )
             run.obs = self._open_obs(run_id, cfg, run.title)
+            self._init_trace(run, traceparent)
             run.obs.emit(
                 "run_submitted",
                 run_id=run_id, title=run.title, signature=run.signature,
+                **self._trace_fields(run),
             )
             self._runs[run_id] = run
             self._order.append(run_id)
@@ -303,7 +385,10 @@ class RunManager:
         return run_id
 
     def submit_idempotent(
-        self, cfg: FedConfig, key: Optional[str] = None
+        self,
+        cfg: FedConfig,
+        key: Optional[str] = None,
+        traceparent: Optional[Tuple[str, str]] = None,
     ) -> Tuple[str, bool]:
         """Submit unless ``key`` was already used; returns ``(run_id,
         created)`` so the HTTP layer can answer 200 instead of 201 on a
@@ -311,7 +396,10 @@ class RunManager:
         with self._lock:
             if key is not None and key in self._idem:
                 return self._idem[key], False
-        return self.submit(cfg, idempotency_key=key), True
+        return (
+            self.submit(cfg, idempotency_key=key, traceparent=traceparent),
+            True,
+        )
 
     def _get(self, run_id: str) -> Run:
         run = self._runs.get(run_id)
@@ -327,7 +415,9 @@ class RunManager:
         with self._lock:
             return [self._runs[rid].info() for rid in self._order]
 
-    def cancel(self, run_id: str) -> Dict[str, Any]:
+    def cancel(
+        self, run_id: str, traceparent: Optional[Tuple[str, str]] = None
+    ) -> Dict[str, Any]:
         """Cancel a run.  Queued runs finalize immediately; running batch
         lanes go dark at the next round boundary (idempotent on done
         runs).  A running SOLO lane cannot be interrupted mid-schedule —
@@ -340,13 +430,35 @@ class RunManager:
             self._requeue_at.pop(run_id, None)
             if run.status == "queued":
                 run.status = "cancelled"
-                run.obs.emit("run_cancelled", run_id=run_id, round=0)
-                run.obs.close()
+                run.obs.emit(
+                    "run_cancelled", run_id=run_id, round=0,
+                    **self._remote_fields(run, traceparent),
+                )
+                self._close_run_obs(run)
                 self.journal.append("cancelled", run_id, round=run.round)
                 self._gauge_queue()
             return run.info()
 
-    def swap(self, run_id: str, knob: str, value) -> Dict[str, Any]:
+    @classmethod
+    def _remote_fields(
+        cls, run: Run, traceparent: Optional[Tuple[str, str]]
+    ) -> Dict[str, Any]:
+        """Trace fields for a control-plane event triggered over HTTP:
+        the run's own trace identity plus, when the client stamped the
+        request with a traceparent, the client's span as
+        ``remote_parent_span_id`` (correlation both ways without
+        grafting a foreign span into the local tree)."""
+        out = cls._trace_fields(run)
+        if out and traceparent is not None:
+            out["remote_parent_span_id"] = traceparent[1]
+            if traceparent[0] != run.trace_id:
+                out["remote_trace_id"] = traceparent[0]
+        return out
+
+    def swap(
+        self, run_id: str, knob: str, value,
+        traceparent: Optional[Tuple[str, str]] = None,
+    ) -> Dict[str, Any]:
         """Hot-swap one batchable knob.  Queued runs take the new value
         into their initial knob stack; running runs get a per-lane
         device-array update at the next round boundary.  Raises
@@ -378,6 +490,7 @@ class RunManager:
                 run.obs.emit(
                     "knob_swap",
                     run_id=run_id, round=0, knob=knob, value=value,
+                    **self._remote_fields(run, traceparent),
                 )
             else:
                 run.swaps.append((knob, value))
@@ -450,11 +563,15 @@ class RunManager:
                         # this tenant into the same lane (seat_order)
                         run.lane_hint = int(st["lane"])
                     run.obs = self._open_obs(run_id, cfg, run.title)
+                    # trace ids are not journaled — a re-adopted tenant
+                    # starts a fresh trace for its new attempt
+                    self._init_trace(run)
                     run.obs.emit(
                         "journal_replay",
                         run_id=run_id,
                         status="resumed" if run.resume_round else "restarted",
                         round=run.resume_round,
+                        **self._trace_fields(run),
                     )
                     self._pending.append(run_id)
                     requeued.append(run_id)
@@ -599,12 +716,13 @@ class RunManager:
                     run.obs.emit(
                         "run_failed",
                         run_id=run.run_id, round=run.round, reason=run.error,
+                        **self._trace_fields(run),
                     )
                     self.journal.append(
                         "failed", run.run_id,
                         round=run.round, reason=run.error,
                     )
-                run.obs.close()
+                self._close_run_obs(run)
 
     def _load_lane_resume(
         self, run: Run
@@ -677,6 +795,14 @@ class RunManager:
                 run.lane_hint = lane
                 run.resume_round = start_rounds[lane]
                 run.round = start_rounds[lane]
+                # admission latency, submit -> lane seat (traced no-op
+                # otherwise); feeds aircomp_queue_wait_seconds and the
+                # queue_wait_p99 alert
+                run.obs.span_event(
+                    "queue_wait",
+                    ms=(time.time() - run.submitted_at) * 1e3,
+                    run_id=run.run_id, lane=lane,
+                )
 
         def _live(run: Run) -> bool:
             """Still this group's run?  A watchdog requeue bumps the
@@ -702,6 +828,12 @@ class RunManager:
             run.lane_hint = lane
             run.wedged = False
             run.last_progress = time.time()
+            run.obs.span_event(
+                "queue_wait",
+                ms=(time.time() - run.submitted_at) * 1e3,
+                run_id=run.run_id, lane=lane,
+            )
+            t_install = time.perf_counter()
             rr, restored, rpaths = self._load_lane_resume(run)
             # WAL discipline: the refill record lands BEFORE the device
             # splice, so a SIGKILL between the two replays this tenant
@@ -723,8 +855,9 @@ class RunManager:
                 run.obs.emit(
                     "run_failed",
                     run_id=run.run_id, round=rr, reason=run.error,
+                    **self._trace_fields(run),
                 )
-                run.obs.close()
+                self._close_run_obs(run)
                 self.journal.append(
                     "failed", run.run_id, round=rr, reason=run.error,
                 )
@@ -734,9 +867,15 @@ class RunManager:
             run.round = rr
             seated[lane] = run
             group_runs.append(run)
+            run.obs.span_event(
+                "lane_install",
+                ms=(time.perf_counter() - t_install) * 1e3,
+                run_id=run.run_id, lane=lane, round=rr,
+            )
             run.obs.emit(
                 "lane_refill",
                 run_id=run.run_id, lane=lane, round=rr, group_round=step,
+                **self._trace_fields(run),
             )
             self._sched.emit(
                 "lane_refill",
@@ -820,9 +959,10 @@ class RunManager:
                         _release(lane)
                         run.status = "cancelled"
                         run.obs.emit(
-                            "run_cancelled", run_id=run.run_id, round=rnd
+                            "run_cancelled", run_id=run.run_id, round=rnd,
+                            **self._trace_fields(run),
                         )
-                        run.obs.close()
+                        self._close_run_obs(run)
                         self.journal.append(
                             "cancelled", run.run_id, round=rnd
                         )
@@ -838,6 +978,7 @@ class RunManager:
                             "knob_swap",
                             run_id=run.run_id, round=rnd,
                             knob=knob, value=value,
+                            **self._trace_fields(run),
                         )
                     run.swaps = []
                     run.round = rnd
@@ -856,8 +997,9 @@ class RunManager:
                 run.obs.emit(
                     "run_failed",
                     run_id=run.run_id, round=rnd, reason=run.error,
+                    **self._trace_fields(run),
                 )
-                run.obs.close()
+                self._close_run_obs(run)
                 self.journal.append(
                     "failed", run.run_id, round=rnd, reason=run.error
                 )
@@ -938,7 +1080,7 @@ class RunManager:
                     final_val_acc=paths["valAccPath"][-1],
                     final_val_loss=paths["valLossPath"][-1],
                 )
-                run.obs.close()
+                self._close_run_obs(run)
                 seated[lane] = None
 
         try:
@@ -975,8 +1117,14 @@ class RunManager:
         with self._lock:
             run.lane = 0
             run.last_progress = time.time()
+            run.obs.span_event(
+                "queue_wait",
+                ms=(time.time() - run.submitted_at) * 1e3,
+                run_id=run_id, lane=0,
+            )
         # hand the stream over: the harness's own sink appends after ours
         run.obs.close()
+        run.obs = obs_lib.NULL
         solo_cfg = dataclasses.replace(run.cfg, inherit=True)
 
         def on_ckpt(rnd: int) -> None:
@@ -985,24 +1133,34 @@ class RunManager:
                 run.round = rnd
                 run.last_progress = time.time()
 
-        try:
-            record = harness.run(
+        def _exec():
+            return harness.run(
                 solo_cfg,
                 record_in_file=True,
                 persist_paths=True,
                 on_checkpoint=on_ckpt,
             )
+
+        try:
+            if run.trace_id is not None:
+                # the harness's own "run" span (and everything under it)
+                # adopts the tenant's trace and parents to the pre-minted
+                # run_request root — one tree across the handover
+                with obs_lib.trace.activate(run.trace_id, run.root_span_id):
+                    record = _exec()
+            else:
+                record = _exec()
         except Exception as exc:
             err = f"{type(exc).__name__}: {exc}"
             with self._lock:
                 run.status = "failed"
                 run.error = err
-                obs = self._open_obs(run_id, run.cfg, run.title)
-                obs.emit(
-                    "run_failed", run_id=run_id, round=run.round, reason=err
+                self._reopen_obs(run)
+                run.obs.emit(
+                    "run_failed", run_id=run_id, round=run.round, reason=err,
+                    **self._trace_fields(run),
                 )
-                obs.close()
-                run.obs = obs_lib.NULL
+                self._close_run_obs(run)
             self.journal.append(
                 "failed", run_id, round=run.round, reason=err
             )
@@ -1018,7 +1176,14 @@ class RunManager:
             run.status = "completed"
             run.round = run.cfg.rounds
             run.record_path = harness.cache_path(run.cfg, record["name"])
-            run.obs = obs_lib.NULL
+            if run.trace_id is not None:
+                # reopen the handed-back stream just long enough to seal
+                # the trace: the run_request root appends after the
+                # harness's own events, closing the tree
+                self._reopen_obs(run)
+                self._close_run_obs(run)
+            else:
+                run.obs = obs_lib.NULL
         self.journal.append(
             "completed",
             run_id,

@@ -30,10 +30,13 @@ contract (what may differ across runs sharing one compiled trainer).
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Dict, Optional, Tuple
 
 from .. import obs as obs_lib
 from ..fed.config import config_from_mapping
+from ..obs.metrics import HTTP_SECONDS_BUCKETS
+from ..obs.trace import parse_traceparent
 from .runs import QueueFull, RunManager
 
 _JSON = "application/json"
@@ -152,21 +155,53 @@ class ExperimentServer:
             and not self._authorized(headers or {})
         ):
             return self._json(401, {"error": "unauthorized"})
+        # W3C-style trace continuity: a client that stamps its submit /
+        # cancel / knob-swap with ``traceparent`` sees its trace id on
+        # every event the request produces (only for --trace on tenants;
+        # untraced streams stay byte-identical)
+        traceparent = parse_traceparent((headers or {}).get("traceparent"))
+        t0 = time.perf_counter()
         try:
-            return self._dispatch(method, parts, body)
+            out = self._dispatch(method, parts, body, traceparent)
         except KeyError as exc:
-            return self._json(404, {"error": str(exc).strip("'\"")})
+            out = self._json(404, {"error": str(exc).strip("'\"")})
         except QueueFull as exc:  # backpressure, not a client error
-            return self._json(429, {"error": str(exc)})
+            out = self._json(429, {"error": str(exc)})
         except ValueError as exc:  # includes json.JSONDecodeError
-            return self._json(400, {"error": str(exc)})
+            out = self._json(400, {"error": str(exc)})
         except Exception as exc:  # noqa: BLE001 — surface, don't kill the thread
-            return self._json(
+            out = self._json(
                 500, {"error": f"{type(exc).__name__}: {exc}"}
             )
+        if out is not None:
+            # server-measured request latency, by route template (never
+            # the raw path — run ids would explode the label space).
+            # This is what the soak harness cross-checks its client-side
+            # p99 against: a slow server is visible between soaks too.
+            self.registry.observe(
+                "aircomp_http_request_seconds",
+                time.perf_counter() - t0,
+                buckets=HTTP_SECONDS_BUCKETS,
+                help_text="server-side run-API request latency by route",
+                route=self._route_label(method, parts),
+            )
+        return out
+
+    @staticmethod
+    def _route_label(method: str, parts: list) -> str:
+        if parts[:1] != ["runs"]:
+            return "other"
+        if len(parts) == 1:
+            return f"{method} /runs"
+        if len(parts) == 2:
+            return f"{method} /runs/<id>"
+        if len(parts) == 3 and parts[2] in ("cancel", "knobs"):
+            return f"{method} /runs/<id>/{parts[2]}"
+        return "other"
 
     def _dispatch(
-        self, method: str, parts: list, body: bytes
+        self, method: str, parts: list, body: bytes,
+        traceparent: Optional[Tuple[str, str]] = None,
     ) -> Optional[Tuple[int, str, bytes]]:
         if not parts or parts[0] != "runs":
             return None
@@ -186,7 +221,8 @@ class ExperimentServer:
                 if key is not None and not isinstance(key, str):
                     raise ValueError("idempotency_key must be a string")
                 run_id, created = mgr.submit_idempotent(
-                    config_from_mapping(overrides), key=key
+                    config_from_mapping(overrides), key=key,
+                    traceparent=traceparent,
                 )
                 return self._json(201 if created else 200, mgr.get(run_id))
             if method == "GET":
@@ -194,7 +230,9 @@ class ExperimentServer:
         elif len(parts) == 2 and method == "GET":
             return self._json(200, mgr.get(parts[1]))
         elif len(parts) == 3 and parts[2] == "cancel" and method == "POST":
-            return self._json(200, mgr.cancel(parts[1]))
+            return self._json(
+                200, mgr.cancel(parts[1], traceparent=traceparent)
+            )
         elif len(parts) == 3 and parts[2] == "knobs" and method == "POST":
             swaps = json.loads(body.decode() or "{}")
             if not isinstance(swaps, dict) or not swaps:
@@ -204,6 +242,8 @@ class ExperimentServer:
                 )
             info = None
             for knob, value in swaps.items():
-                info = mgr.swap(parts[1], knob, value)
+                info = mgr.swap(
+                    parts[1], knob, value, traceparent=traceparent
+                )
             return self._json(200, info)
         return None

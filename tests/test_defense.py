@@ -261,6 +261,8 @@ def test_config_hash_off_matches_predefense_formula():
         "sign_bits",
         # output-only like the obs knobs: skipped unconditionally
         "dispatch_prefetch", "async_writer",
+        # distributed tracing only mints ids onto emitted events/headers
+        "trace",
     )
     items = sorted(
         (f.name, repr(getattr(cfg, f.name)))

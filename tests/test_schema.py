@@ -67,6 +67,14 @@ GOLDEN = {
     # serve/runs.py, serve/elastic.py, docs/SERVING.md "Elastic lane
     # groups")
     9: "78db1defadd3c80a",
+    # v10 added the trace_id / span_id / parent_span_id ENVELOPE keys
+    # (stamped by make_event from the ambient trace context, like
+    # host_id in v5, so _REQUIRED is untouched and the fingerprint
+    # legitimately matches v9's) — but the version bump is real:
+    # consumers joining cross-process traces key on (trace_id, span_id)
+    # from v10 on (obs/trace.py, analysis/trace_view.py,
+    # docs/OBSERVABILITY.md "Distributed tracing")
+    10: "78db1defadd3c80a",
 }
 
 

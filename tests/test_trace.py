@@ -1,0 +1,327 @@
+"""Distributed tracing: id minting, traceparent wire format, span
+nesting, off-mode bit-identity, writer-rim attribution, cross-process
+assembly, and the traced serving path's one-lowering guarantee.
+
+The acceptance surface of obs/trace.py + analysis/trace_view.py
+(docs/OBSERVABILITY.md "Distributed tracing"): ``--trace on`` makes
+every span mint W3C-shaped ids and nest via the context-local parent
+stack; ``--trace off`` (the default) stays byte-identical to the
+pre-trace stream shape; the knob never forks config_hash or records;
+and the assembler joins per-process JSONL streams into orphan-free
+per-tenant trees.
+"""
+
+import json
+import pickle
+
+import pytest
+
+from byzantine_aircomp_tpu import obs as obs_lib
+from byzantine_aircomp_tpu.analysis import trace_view
+from byzantine_aircomp_tpu.fed.config import FedConfig
+from byzantine_aircomp_tpu.obs import trace as trace_lib
+
+
+def _cfg(rounds=2, **kw):
+    base = dict(
+        dataset="mnist", honest_size=6, byz_size=0, rounds=rounds,
+        display_interval=2, batch_size=16, agg="mean", eval_train=False,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+@pytest.fixture
+def synthetic_mnist(monkeypatch):
+    import byzantine_aircomp_tpu.data.datasets as dl
+
+    orig = dl.load
+    monkeypatch.setattr(
+        dl, "load",
+        lambda name, **kw: orig(name, synthetic_train=600, synthetic_val=200),
+    )
+
+
+# ------------------------------------------------- ids + wire format
+
+
+def test_id_formats():
+    tids = {trace_lib.new_trace_id() for _ in range(32)}
+    sids = {trace_lib.new_span_id() for _ in range(32)}
+    assert len(tids) == 32 and len(sids) == 32  # 128/64-bit: no collisions
+    assert all(len(t) == 32 and int(t, 16) >= 0 for t in tids)
+    assert all(len(s) == 16 and int(s, 16) >= 0 for s in sids)
+
+
+def test_traceparent_roundtrip_and_rejection():
+    tid, sid = trace_lib.new_trace_id(), trace_lib.new_span_id()
+    header = trace_lib.format_traceparent(tid, sid)
+    assert header == f"00-{tid}-{sid}-01"
+    assert trace_lib.parse_traceparent(header) == (tid, sid)
+    # tolerant of case and surrounding whitespace (proxies normalize)
+    assert trace_lib.parse_traceparent(f"  {header.upper()}  ") == (tid, sid)
+    # W3C-reserved all-zero ids are invalid, as is anything malformed
+    assert trace_lib.parse_traceparent(f"00-{'0'*32}-{sid}-01") is None
+    assert trace_lib.parse_traceparent(f"00-{tid}-{'0'*16}-01") is None
+    assert trace_lib.parse_traceparent("not-a-header") is None
+    assert trace_lib.parse_traceparent("") is None
+    assert trace_lib.parse_traceparent(None) is None
+
+
+def test_traceparent_helper_requires_a_span():
+    assert trace_lib.traceparent() is None  # no ambient context
+    with trace_lib.activate("ab" * 16):
+        assert trace_lib.traceparent() is None  # trace but no span id
+    with trace_lib.activate("ab" * 16, "cd" * 8):
+        assert trace_lib.traceparent() == f"00-{'ab'*16}-{'cd'*8}-01"
+    assert trace_lib.current() is None  # contexts unwind
+
+
+# ------------------------------------------------- span nesting
+
+
+def test_traced_spans_nest_and_stamp_enclosed_events():
+    mem = obs_lib.MemorySink()
+    obs = obs_lib.Observability(mem)
+    obs.traced = True
+    with obs.span("outer"):
+        obs.emit("round", round=0, val_loss=1.0)
+        with obs.span("inner"):
+            pass
+    outer = next(e for e in mem.events if e.get("name") == "outer")
+    inner = next(e for e in mem.events if e.get("name") == "inner")
+    rnd = next(e for e in mem.events if e["kind"] == "round")
+    assert outer["trace_id"] == inner["trace_id"] == rnd["trace_id"]
+    assert inner["parent_span_id"] == outer["span_id"]
+    assert "parent_span_id" not in outer  # first span roots the trace
+    # the round event is stamped WITHIN the enclosing span, not given
+    # its own — events are points, spans are intervals
+    assert rnd["span_id"] == outer["span_id"]
+
+
+def test_span_event_parents_to_trace_root():
+    mem = obs_lib.MemorySink()
+    obs = obs_lib.Observability(mem)
+    obs.traced = True
+    obs.trace_root = ("ab" * 16, "cd" * 8)
+    obs.span_event("queue_wait", ms=12.5, run_id="run-0001")
+    (qw,) = mem.events
+    assert qw["kind"] == "span" and qw["name"] == "queue_wait"
+    assert qw["trace_id"] == "ab" * 16
+    assert qw["parent_span_id"] == "cd" * 8
+    assert len(qw["span_id"]) == 16 and qw["span_id"] != "cd" * 8
+    assert qw["ms"] == 12.5
+    # explicit ids win — the vmapped-lane path stamps its own
+    obs.span_event("round", ms=1.0, trace_id="ef" * 16, span_id="12" * 8)
+    assert mem.events[-1]["trace_id"] == "ef" * 16
+    assert mem.events[-1]["span_id"] == "12" * 8
+    assert "parent_span_id" not in mem.events[-1]  # foreign trace
+
+
+def test_untraced_facade_is_byte_identical():
+    mem = obs_lib.MemorySink()
+    obs = obs_lib.Observability(mem)  # traced defaults to False
+    with obs.span("setup"):
+        obs.emit("round", round=0)
+    obs.span_event("queue_wait", ms=3.0)  # no-op when untraced
+    assert len(mem.events) == 2
+    for e in mem.events:
+        assert "trace_id" not in e and "span_id" not in e
+        assert "parent_span_id" not in e
+
+
+# ------------------------------------------------- knob is output-only
+
+
+def test_config_hash_and_records_ignore_trace_knob(tmp_path, synthetic_mnist):
+    from byzantine_aircomp_tpu.fed import harness
+
+    assert harness.config_hash(_cfg(trace="on")) == \
+        harness.config_hash(_cfg(trace="off"))
+    plain = harness.run(_cfg(2), record_in_file=False)
+    traced = harness.run(
+        _cfg(2, trace="on", obs_dir=str(tmp_path / "obs")),
+        record_in_file=False,
+    )
+    plain.pop("roundsPerSec")
+    traced.pop("roundsPerSec")
+    assert pickle.dumps(plain) == pickle.dumps(traced)
+
+
+def test_trace_off_stream_carries_no_trace_keys(tmp_path, synthetic_mnist):
+    from byzantine_aircomp_tpu.fed import harness
+
+    cfg = _cfg(2, obs_dir=str(tmp_path / "obs"))
+    harness.run(cfg, record_in_file=False)
+    path = obs_lib.events_path(str(tmp_path / "obs"), harness.ckpt_title(cfg))
+    events = [json.loads(l) for l in open(path)]
+    assert events, "run emitted no events"
+    for e in events:
+        assert "trace_id" not in e and "span_id" not in e, e
+
+
+# ------------------------------------------------- writer-rim attribution
+
+
+def test_writer_rim_parents_offthread_work_to_submitting_span():
+    mem = obs_lib.MemorySink()
+    writer = obs_lib.WriterThread()
+    try:
+        with trace_lib.activate("ab" * 16, "cd" * 8):
+            writer.submit_traced(
+                lambda: None, "checkpoint", sink=mem, round=3
+            )
+        writer.drain()
+    finally:
+        writer.close()
+    (span,) = mem.events
+    assert span["kind"] == "span" and span["name"] == "writer_task"
+    assert span["task"] == "checkpoint" and span["round"] == 3
+    assert span["trace_id"] == "ab" * 16
+    assert span["parent_span_id"] == "cd" * 8
+    assert span["queued_ms"] >= 0.0 and span["ms"] >= 0.0
+
+
+def test_writer_rim_untraced_submit_emits_nothing():
+    mem = obs_lib.MemorySink()
+    writer = obs_lib.WriterThread()
+    try:
+        writer.submit_traced(lambda: None, "checkpoint", sink=mem)
+        writer.drain()
+    finally:
+        writer.close()
+    assert mem.events == []
+
+
+# ------------------------------------------------- assembler
+
+
+def _span(tid, sid, name, ts, ms, parent=None, **extra):
+    e = dict(
+        v=obs_lib.SCHEMA_VERSION, kind="span", ts=ts, host_id=0,
+        name=name, ms=ms, trace_id=tid, span_id=sid, **extra,
+    )
+    if parent is not None:
+        e["parent_span_id"] = parent
+    return e
+
+
+def test_assemble_joins_streams_and_flags_orphans():
+    tid = "ab" * 16
+    good = [
+        _span(tid, "a" * 16, "run_request", 10.0, 1000.0),
+        _span(tid, "b" * 16, "round", 9.5, 400.0, parent="a" * 16),
+        # parent never emitted anywhere: MUST be flagged, not dropped
+        _span(tid, "c" * 16, "eval", 9.9, 50.0, parent="f" * 16),
+    ]
+    traces = trace_view.assemble(good)
+    assert set(traces) == {tid}
+    t = traces[tid]
+    assert len(t["spans"]) == 3
+    assert [o["span_id"] for o in t["orphans"]] == ["c" * 16]
+    # a complete tree has none
+    complete = trace_view.assemble(good[:2])
+    assert complete[tid]["orphans"] == []
+
+
+def test_critical_path_accounting():
+    tid = "ab" * 16
+    # root spans [0, 10]s; child [2, 6]s → root self-time 6s, child 4s
+    spans = [
+        _span(tid, "a" * 16, "run_request", 10.0, 10_000.0),
+        _span(tid, "b" * 16, "round", 6.0, 4_000.0,
+              parent="a" * 16, round=0),
+    ]
+    self_ms = trace_view.self_times(spans)
+    assert self_ms["a" * 16] == pytest.approx(6_000.0)
+    assert self_ms["b" * 16] == pytest.approx(4_000.0)
+    stages = {r["stage"]: r for r in trace_view.stage_table(spans)}
+    assert stages["run_request"]["self_ms"] == pytest.approx(6_000.0)
+    assert stages["round"]["self_ms"] == pytest.approx(4_000.0)
+    (r0,) = trace_view.round_table([spans[1]])
+    assert r0["round"] == 0 and r0["coverage"] == pytest.approx(1.0)
+
+
+def test_perfetto_export_shape():
+    tid = "ab" * 16
+    spans = [
+        _span(tid, "a" * 16, "run_request", 10.0, 1000.0),
+        _span(tid, "b" * 16, "round", 9.8, 500.0,
+              parent="a" * 16, round=1, lane=2),
+    ]
+    for s in spans:
+        s["_stream"] = "run-0001.events.jsonl"
+    traces = trace_view.assemble(spans)
+    evs = trace_view.perfetto_events(traces)
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 2
+    assert all(e["ts"] >= 0 and e["dur"] > 0 for e in xs)  # µs, rebased
+    ms = [e for e in evs if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in ms)
+    json.dumps({"traceEvents": evs})  # must be JSON-serializable
+
+
+# ------------------------------------------------- traced serving path
+
+
+def test_traced_tenants_share_one_lowering(tmp_path, synthetic_mnist):
+    """The ISSUE's no-regression bar: tracing a batched group costs
+    zero extra lowerings, and the assembled per-run trees are complete
+    (run_request root, queue_wait/round spans, zero orphans)."""
+    from byzantine_aircomp_tpu.serve.runs import RunManager
+
+    mgr = RunManager(str(tmp_path / "root"))
+    client_span = "cd" * 8
+    ids = [
+        mgr.submit(
+            _cfg(rounds=2, seed=s, trace="on"),
+            traceparent=("ab" * 16, client_span),
+        )
+        for s in range(3)
+    ]
+    mgr.drain()
+    infos = [mgr.get(rid) for rid in ids]
+    assert all(i["status"] == "completed" for i in infos)
+    assert all(i["lowerings"] == 1 for i in infos)
+    # the submit header's trace id was adopted, not re-minted
+    assert all(i["trace_id"] == "ab" * 16 for i in infos)
+
+    events = trace_view.load_streams(
+        trace_view.find_streams(str(tmp_path / "root")),
+        root=str(tmp_path / "root"),
+    )
+    traces = trace_view.assemble(events)
+    assert set(traces) == {"ab" * 16}
+    t = traces["ab" * 16]
+    assert t["orphans"] == []
+    names = {s["name"] for s in t["spans"]}
+    assert {"run_request", "queue_wait", "round"} <= names
+    roots = [s for s in t["spans"] if s["name"] == "run_request"]
+    assert len(roots) == len(ids)  # one request-lifecycle root per run
+    for r in roots:
+        # the client's span rides in remote_parent_span_id — NEVER
+        # parent_span_id, so local orphan detection stays meaningful
+        assert r["remote_parent_span_id"] == client_span
+        assert "parent_span_id" not in r
+        assert r["status"] == "completed" and r["ms"] > 0
+    rounds = [s for s in t["spans"] if s["name"] == "round"]
+    assert {s["round"] for s in rounds} == {0, 1}
+
+
+def test_untraced_tenant_stream_unchanged_by_retrace_of_schema(
+    tmp_path, synthetic_mnist
+):
+    """A --trace off tenant through the SAME manager emits a stream
+    with zero trace envelope keys — the v10 bump is additive only."""
+    from byzantine_aircomp_tpu.serve.runs import RunManager
+
+    mgr = RunManager(str(tmp_path / "root"))
+    rid = mgr.submit(_cfg(rounds=2))
+    mgr.drain()
+    info = mgr.get(rid)
+    assert info["status"] == "completed"
+    assert "trace_id" not in info
+    for path in trace_view.find_streams(str(tmp_path / "root")):
+        for line in open(path):
+            e = json.loads(line)
+            assert "trace_id" not in e and "span_id" not in e, e
